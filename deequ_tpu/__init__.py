@@ -1,0 +1,82 @@
+"""deequ_tpu — a TPU-native data-quality verification framework.
+
+A brand-new JAX/XLA implementation of the capabilities of AWS Labs deequ
+("unit tests for data", reference: /root/reference): declarative checks are
+compiled into a minimal number of fused device scan passes, analyzer states
+form commutative monoids that merge across devices (ICI collectives) and
+across time (incremental computation), and everything driver-side
+(constraints, repository, anomaly detection, profiling, suggestion) is plain
+Python operating on collected scalars.
+
+Architecture (see SURVEY.md for the reference layer map):
+
+  - ``deequ_tpu.data``      — columnar tables (dictionary-encoded strings)
+  - ``deequ_tpu.expr``      — SQL-subset predicate DSL (where / satisfies)
+  - ``deequ_tpu.analyzers`` — ~25 metric analyzers + the fused-scan planner
+  - ``deequ_tpu.ops``       — JAX kernels: fused reductions, segment group-by,
+                              HLL++, KLL sketches
+  - ``deequ_tpu.parallel``  — device mesh + shard_map row-sharding + tagged
+                              collective state merges
+  - ``deequ_tpu.checks``    — the fluent Check DSL (reference: checks/Check.scala)
+  - ``deequ_tpu.verification`` — VerificationSuite entry point
+  - ``deequ_tpu.states``    — state persistence (incremental compute backbone)
+  - ``deequ_tpu.repository`` — metric time-series store + query DSL
+  - ``deequ_tpu.anomaly``   — anomaly detection strategies
+  - ``deequ_tpu.profiles``  — column profiler
+  - ``deequ_tpu.suggestions`` — constraint suggestion rules
+
+Numeric note: metric semantics follow the reference's double precision; we
+enable jax x64 so device aggregation states are float64 (bandwidth-bound, not
+MXU-bound, so this costs little on TPU).
+"""
+
+import os as _os
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: each analysis run builds a fresh fused
+# program; identical (analyzer-set, schema, chunk-shape) programs then hit
+# this cache instead of recompiling (TPU compiles go through a slow remote
+# tunnel in this environment, ~10-30s each).
+_cache_dir = _os.environ.get(
+    "DEEQU_TPU_COMPILATION_CACHE", _os.path.expanduser("~/.cache/deequ_tpu_xla")
+)
+if _cache_dir:
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+from deequ_tpu.metrics import (  # noqa: E402
+    DoubleMetric,
+    Entity,
+    HistogramMetric,
+    KeyedDoubleMetric,
+    Metric,
+)
+from deequ_tpu.data.table import ColumnarTable  # noqa: E402
+from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
+from deequ_tpu.verification import (  # noqa: E402
+    VerificationResult,
+    VerificationSuite,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Check",
+    "CheckLevel",
+    "CheckStatus",
+    "ColumnarTable",
+    "DoubleMetric",
+    "Entity",
+    "HistogramMetric",
+    "KeyedDoubleMetric",
+    "Metric",
+    "VerificationResult",
+    "VerificationSuite",
+]

@@ -1,0 +1,66 @@
+from deequ_tpu.analyzers.base import (
+    Analyzer,
+    ScanShareableAnalyzer,
+    State,
+    DoubleValuedState,
+)
+from deequ_tpu.analyzers.states import (
+    CorrelationState,
+    DataTypeHistogram,
+    MaxState,
+    MeanState,
+    MinState,
+    NumMatches,
+    NumMatchesAndCount,
+    StandardDeviationState,
+    SumState,
+)
+from deequ_tpu.analyzers.scan import (
+    Completeness,
+    Compliance,
+    Correlation,
+    DataType,
+    DataTypeInstances,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    Patterns,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.grouping import (
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    FrequenciesAndNumRows,
+    FrequencyBasedAnalyzer,
+    Histogram,
+    MutualInformation,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.analyzers.sketches import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    KLLParameters,
+    KLLSketch,
+)
+
+__all__ = [
+    "Analyzer", "ScanShareableAnalyzer", "State", "DoubleValuedState",
+    "NumMatches", "NumMatchesAndCount", "MinState", "MaxState", "MeanState",
+    "SumState", "StandardDeviationState", "CorrelationState", "DataTypeHistogram",
+    "Size", "Completeness", "Compliance", "PatternMatch", "Patterns",
+    "Minimum", "Maximum", "MinLength", "MaxLength", "Mean", "Sum",
+    "StandardDeviation", "Correlation", "DataType", "DataTypeInstances",
+    "Uniqueness", "UniqueValueRatio", "Distinctness", "CountDistinct",
+    "Entropy", "MutualInformation", "Histogram", "FrequenciesAndNumRows",
+    "FrequencyBasedAnalyzer",
+    "ApproxCountDistinct", "ApproxQuantile", "ApproxQuantiles",
+    "KLLSketch", "KLLParameters",
+]

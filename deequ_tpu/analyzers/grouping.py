@@ -1,0 +1,391 @@
+"""Grouping (frequency-based) analyzers (reference §2.3 of SURVEY.md,
+analyzers/GroupingAnalyzers.scala + Uniqueness/Distinctness/etc.).
+
+All analyzers over one distinct set of grouping columns share ONE frequency
+computation per analysis run (the planner guarantees this, mirroring
+AnalysisRunner.scala:175-190). The frequency state is a mergeable monoid:
+merging two frequency tables is a null-safe outer join adding counts
+(GroupingAnalyzers.scala:127-147) — here a dictionary merge.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.analyzers.base import (
+    Analyzer,
+    State,
+    at_least_one,
+    entity_from,
+    exactly_n_columns,
+    has_column,
+    metric_from_failure,
+    metric_from_value,
+)
+from deequ_tpu.data.table import ColumnarTable, DType
+from deequ_tpu.exceptions import (
+    EmptyStateException,
+    IllegalAnalyzerParameterException,
+)
+from deequ_tpu.metrics import (
+    Distribution,
+    DistributionValue,
+    DoubleMetric,
+    Entity,
+    HistogramMetric,
+)
+from deequ_tpu.ops.segment import group_counts
+from deequ_tpu.tryresult import Failure, Success
+
+
+@dataclass(frozen=True)
+class FrequenciesAndNumRows(State):
+    """Group frequencies + total row count (at least one grouping column
+    non-null). Merge = add counts across the union of groups."""
+
+    columns: Tuple[str, ...]
+    frequencies: Tuple[Tuple[tuple, int], ...]  # sorted items, hashable
+    num_rows: int
+
+    @staticmethod
+    def from_dict(
+        columns: Sequence[str], frequencies: Dict[tuple, int], num_rows: int
+    ) -> "FrequenciesAndNumRows":
+        items = tuple(sorted(frequencies.items(), key=lambda kv: repr(kv[0])))
+        return FrequenciesAndNumRows(tuple(columns), items, num_rows)
+
+    def as_dict(self) -> Dict[tuple, int]:
+        return dict(self.frequencies)
+
+    def sum(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
+        if self.columns != other.columns:
+            raise ValueError(
+                f"cannot merge frequency states over different columns: "
+                f"{self.columns} vs {other.columns}"
+            )
+        merged = self.as_dict()
+        for group, count in other.frequencies:
+            merged[group] = merged.get(group, 0) + count
+        return FrequenciesAndNumRows.from_dict(
+            self.columns, merged, self.num_rows + other.num_rows
+        )
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.frequencies)
+
+    def counts_array(self) -> np.ndarray:
+        return np.array([c for _, c in self.frequencies], dtype=np.int64)
+
+
+class FrequencyBasedAnalyzer(Analyzer):
+    """Base class for analyzers operating on group frequencies."""
+
+    @property
+    def group_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def instance(self) -> str:
+        return ",".join(self.group_columns)
+
+    @property
+    def entity(self) -> Entity:
+        return entity_from(self.group_columns)
+
+    def preconditions(self):
+        cols = self.group_columns
+        return [at_least_one(cols)] + [has_column(c) for c in cols]
+
+    def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
+        freqs, num_rows = group_counts(table, self.group_columns)
+        return FrequenciesAndNumRows.from_dict(self.group_columns, freqs, num_rows)
+
+
+class ScanShareableFrequencyBasedAnalyzer(FrequencyBasedAnalyzer):
+    """Computes one double from the shared frequency table
+    (reference GroupingAnalyzers.scala:83-120)."""
+
+    metric_name: str = ""
+
+    def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        raise NotImplementedError
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
+        if state is None:
+            return self.to_failure_metric(
+                EmptyStateException(f"Empty state for analyzer {self!r}.")
+            )
+        try:
+            value = self.compute_from_frequencies(state)
+        except Exception as e:  # noqa: BLE001
+            return self.to_failure_metric(e)
+        return metric_from_value(value, self.metric_name, self.instance, self.entity)
+
+    def to_failure_metric(self, exception: Exception) -> DoubleMetric:
+        return metric_from_failure(
+            exception, self.metric_name, self.instance, self.entity
+        )
+
+
+@dataclass(frozen=True)
+class Uniqueness(ScanShareableFrequencyBasedAnalyzer):
+    """Fraction of groups occurring exactly once over all rows
+    (reference analyzers/Uniqueness.scala:26-38)."""
+
+    columns: Tuple[str, ...]
+
+    metric_name = "Uniqueness"
+
+    def __init__(self, columns):
+        object.__setattr__(
+            self, "columns",
+            (columns,) if isinstance(columns, str) else tuple(columns),
+        )
+
+    @property
+    def group_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        counts = state.counts_array()
+        if state.num_rows == 0:
+            return float("nan")
+        return float((counts == 1).sum() / state.num_rows)
+
+
+@dataclass(frozen=True)
+class UniqueValueRatio(ScanShareableFrequencyBasedAnalyzer):
+    """(#groups with count 1) / (#distinct groups)
+    (reference analyzers/UniqueValueRatio.scala:25-44)."""
+
+    columns: Tuple[str, ...]
+
+    metric_name = "UniqueValueRatio"
+
+    def __init__(self, columns):
+        object.__setattr__(
+            self, "columns",
+            (columns,) if isinstance(columns, str) else tuple(columns),
+        )
+
+    @property
+    def group_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        counts = state.counts_array()
+        if len(counts) == 0:
+            return float("nan")
+        return float((counts == 1).sum() / len(counts))
+
+
+@dataclass(frozen=True)
+class Distinctness(ScanShareableFrequencyBasedAnalyzer):
+    """#distinct groups / #rows (reference analyzers/Distinctness.scala:29-41)."""
+
+    columns: Tuple[str, ...]
+
+    metric_name = "Distinctness"
+
+    def __init__(self, columns):
+        object.__setattr__(
+            self, "columns",
+            (columns,) if isinstance(columns, str) else tuple(columns),
+        )
+
+    @property
+    def group_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        if state.num_rows == 0:
+            return float("nan")
+        return float(state.num_groups / state.num_rows)
+
+
+@dataclass(frozen=True)
+class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
+    """Exact number of distinct groups (reference analyzers/CountDistinct.scala)."""
+
+    columns: Tuple[str, ...]
+
+    metric_name = "CountDistinct"
+
+    def __init__(self, columns):
+        object.__setattr__(
+            self, "columns",
+            (columns,) if isinstance(columns, str) else tuple(columns),
+        )
+
+    @property
+    def group_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        return float(state.num_groups)
+
+
+@dataclass(frozen=True)
+class Entropy(ScanShareableFrequencyBasedAnalyzer):
+    """Shannon entropy over the group distribution
+    (reference analyzers/Entropy.scala:28-42)."""
+
+    column: str
+
+    metric_name = "Entropy"
+
+    @property
+    def group_columns(self) -> List[str]:
+        return [self.column]
+
+    def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
+        n = state.num_rows
+        if n == 0:
+            return float("nan")
+        counts = state.counts_array().astype(np.float64)
+        p = counts / n
+        nonzero = p > 0
+        return float(-(p[nonzero] * np.log(p[nonzero])).sum())
+
+
+@dataclass(frozen=True)
+class MutualInformation(FrequencyBasedAnalyzer):
+    """Mutual information of two columns from the joint frequency table
+    (reference analyzers/MutualInformation.scala:35-103). Groups where either
+    column is null drop out (the reference's equality joins skip null keys)."""
+
+    columns: Tuple[str, str]
+
+    def __init__(self, column_a, column_b=None):
+        if column_b is None:
+            cols = tuple(column_a)
+        else:
+            cols = (column_a, column_b)
+        object.__setattr__(self, "columns", cols)
+
+    @property
+    def group_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def preconditions(self):
+        return [exactly_n_columns(self.columns, 2)] + super().preconditions()
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
+        if state is None:
+            return self.to_failure_metric(
+                EmptyStateException(f"Empty state for analyzer {self!r}.")
+            )
+        total = state.num_rows
+        if total == 0:
+            return self.to_failure_metric(
+                EmptyStateException(f"Empty state for analyzer {self!r}.")
+            )
+        marginal_a: Dict[object, int] = {}
+        marginal_b: Dict[object, int] = {}
+        for (va, vb), c in state.frequencies:
+            marginal_a[va] = marginal_a.get(va, 0) + c
+            marginal_b[vb] = marginal_b.get(vb, 0) + c
+        mi = 0.0
+        for (va, vb), c in state.frequencies:
+            if va is None or vb is None:
+                continue
+            pxy = c / total
+            px = marginal_a[va] / total
+            py = marginal_b[vb] / total
+            mi += pxy * math.log(pxy / (px * py))
+        return metric_from_value(mi, "MutualInformation", self.instance, Entity.MULTICOLUMN)
+
+    def to_failure_metric(self, exception: Exception) -> DoubleMetric:
+        return metric_from_failure(
+            exception, "MutualInformation", self.instance, Entity.MULTICOLUMN
+        )
+
+
+MAXIMUM_ALLOWED_DETAIL_BINS = 1000
+NULL_FIELD_REPLACEMENT = "NullValue"
+
+
+def _stringify(value) -> str:
+    """Render a group value the way the reference's string cast does."""
+    if value is None:
+        return NULL_FIELD_REPLACEMENT
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, float) and value.is_integer():
+        return f"{value:.1f}"
+    return str(value)
+
+
+@dataclass(frozen=True)
+class Histogram(FrequencyBasedAnalyzer):
+    """Full value histogram with optional binning function and top-N detail
+    (reference analyzers/Histogram.scala:41-117). Unlike the other grouping
+    analyzers this runs its own pass (nulls become 'NullValue' and num_rows
+    counts ALL rows)."""
+
+    column: str
+    binning_udf: Optional[Callable] = None
+    max_detail_bins: int = MAXIMUM_ALLOWED_DETAIL_BINS
+
+    @property
+    def group_columns(self) -> List[str]:
+        return [self.column]
+
+    def preconditions(self):
+        def param_check(schema):
+            if self.max_detail_bins > MAXIMUM_ALLOWED_DETAIL_BINS:
+                raise IllegalAnalyzerParameterException(
+                    f"Cannot return histogram values for more than "
+                    f"{MAXIMUM_ALLOWED_DETAIL_BINS} values"
+                )
+
+        return [param_check, has_column(self.column)]
+
+    def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
+        total_count = table.num_rows
+        col = table[self.column]
+        if self.binning_udf is not None:
+            binned = [
+                None if v is None else self.binning_udf(v) for v in col.to_pylist()
+            ]
+            binned_table = ColumnarTable.from_pydict({self.column: binned})
+            freqs, _ = group_counts(
+                binned_table, [self.column], require_any_non_null=False
+            )
+        else:
+            freqs, _ = group_counts(table, [self.column], require_any_non_null=False)
+        # stringify group values, nulls -> NullValue (Histogram.scala:108-111)
+        str_freqs: Dict[tuple, int] = {}
+        for (value,), count in freqs.items():
+            key = (_stringify(value),)
+            str_freqs[key] = str_freqs.get(key, 0) + count
+        return FrequenciesAndNumRows.from_dict((self.column,), str_freqs, total_count)
+
+    def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> HistogramMetric:
+        if state is None:
+            return self.to_failure_metric(
+                EmptyStateException(f"Empty state for analyzer {self!r}.")
+            )
+
+        def build() -> Distribution:
+            items = sorted(state.frequencies, key=lambda kv: kv[1], reverse=True)
+            top = items[: self.max_detail_bins]
+            details = {
+                key[0]: DistributionValue(count, count / state.num_rows)
+                for key, count in top
+            }
+            return Distribution(details, number_of_bins=state.num_groups)
+
+        from deequ_tpu.tryresult import Try
+
+        return HistogramMetric(self.column, Try.of(build))
+
+    def to_failure_metric(self, exception: Exception) -> HistogramMetric:
+        from deequ_tpu.exceptions import wrap_if_necessary
+
+        return HistogramMetric(self.column, Failure(wrap_if_necessary(exception)))

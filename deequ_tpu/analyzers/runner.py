@@ -1,0 +1,343 @@
+"""AnalysisRunner — the query planner (reference layer L4,
+analyzers/runners/AnalysisRunner.scala).
+
+Planning pipeline, mirroring doAnalysisRun (reference L97-203):
+
+1. skip analyzers whose results already exist in the repository;
+2. partition analyzers by failing preconditions -> failure metrics;
+3. split {scan-shareable | grouping | own-pass (KLL / quantile / histogram)};
+4. fuse ALL scan-shareable analyzers into ONE compiled device pass
+   (ops/scan_engine.py — the analogue of the single data.agg(...) job);
+5. for each distinct grouping-column set, compute frequencies ONCE and run
+   all its analyzers against the shared frequency state;
+6. merge contexts, optionally save states / results.
+
+Partial failure is data: a failure inside the fused scan maps onto every
+participating analyzer (reference L320-323); precondition failures become
+failure metrics instead of aborting (L137-145).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.analyzers.base import (
+    Analyzer,
+    ScanShareableAnalyzer,
+    State,
+    find_first_failing,
+    merge_states,
+)
+from deequ_tpu.analyzers.grouping import (
+    FrequenciesAndNumRows,
+    FrequencyBasedAnalyzer,
+    Histogram,
+)
+from deequ_tpu.data.table import ColumnarTable, Schema
+from deequ_tpu.exceptions import wrap_if_necessary
+from deequ_tpu.metrics import DoubleMetric, Metric
+from deequ_tpu.ops.scan_engine import run_scan
+
+
+class ReusingNotPossibleResultsMissingException(RuntimeError):
+    """Raised when fail_if_results_missing is set and the repository lacks
+    some requested analyzer results (reference AnalysisRunner.scala:552)."""
+
+
+@dataclass
+class AnalyzerContext:
+    """Result map Analyzer -> Metric (reference AnalyzerContext.scala:29-105)."""
+
+    metric_map: Dict[Analyzer, Metric] = field(default_factory=dict)
+
+    @staticmethod
+    def empty() -> "AnalyzerContext":
+        return AnalyzerContext({})
+
+    def all_metrics(self) -> List[Metric]:
+        return list(self.metric_map.values())
+
+    def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
+        merged = dict(self.metric_map)
+        merged.update(other.metric_map)
+        return AnalyzerContext(merged)
+
+    def metric(self, analyzer: Analyzer) -> Optional[Metric]:
+        return self.metric_map.get(analyzer)
+
+    @staticmethod
+    def success_metrics_as_rows(
+        analyzer_context: "AnalyzerContext",
+        for_analyzers: Optional[Sequence[Analyzer]] = None,
+    ) -> List[dict]:
+        """Flattened successful metrics as row dicts (DataFrame analogue)."""
+        rows = []
+        for analyzer, metric in analyzer_context.metric_map.items():
+            if for_analyzers and analyzer not in for_analyzers:
+                continue
+            if not metric.value.is_success:
+                continue
+            for m in metric.flatten():
+                if m.value.is_success:
+                    rows.append(
+                        {
+                            "entity": m.entity.value,
+                            "instance": m.instance,
+                            "name": m.name,
+                            "value": m.value.get(),
+                        }
+                    )
+        return rows
+
+    @staticmethod
+    def success_metrics_as_json(
+        analyzer_context: "AnalyzerContext",
+        for_analyzers: Optional[Sequence[Analyzer]] = None,
+    ) -> str:
+        return json.dumps(
+            AnalyzerContext.success_metrics_as_rows(analyzer_context, for_analyzers)
+        )
+
+
+def _is_grouping_shared(analyzer: Analyzer) -> bool:
+    """Grouping analyzers that share a frequency table per grouping set.
+    Histogram is excluded: its null handling and row count differ, so it
+    runs its own pass (reference Histogram.scala is a plain Analyzer)."""
+    return isinstance(analyzer, FrequencyBasedAnalyzer) and not isinstance(
+        analyzer, Histogram
+    )
+
+
+class AnalysisRunner:
+    """Entry points for computing metrics (reference AnalysisRunner.scala)."""
+
+    @staticmethod
+    def on_data(data: ColumnarTable) -> "AnalysisRunBuilder":
+        from deequ_tpu.analyzers.builder import AnalysisRunBuilder
+
+        return AnalysisRunBuilder(data)
+
+    @staticmethod
+    def do_analysis_run(
+        data: ColumnarTable,
+        analyzers: Sequence[Analyzer],
+        aggregate_with=None,
+        save_states_with=None,
+        metrics_repository=None,
+        reuse_existing_results_for_key=None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key=None,
+    ) -> AnalyzerContext:
+        if not analyzers:
+            return AnalyzerContext.empty()
+
+        analyzers = list(analyzers)
+
+        # (1) repository reuse (reference L116-134)
+        results_loaded = AnalyzerContext.empty()
+        if metrics_repository is not None and reuse_existing_results_for_key is not None:
+            existing = metrics_repository.load_by_key(reuse_existing_results_for_key)
+            if existing is not None:
+                loaded = {
+                    a: m
+                    for a, m in existing.analyzer_context.metric_map.items()
+                    if a in analyzers
+                }
+                results_loaded = AnalyzerContext(loaded)
+        remaining = [a for a in analyzers if a not in results_loaded.metric_map]
+        if fail_if_results_missing and remaining:
+            raise ReusingNotPossibleResultsMissingException(
+                "Could not find all necessary results in the MetricsRepository, "
+                f"the calculation of the metrics for these analyzers would be "
+                f"needed: {', '.join(str(a) for a in remaining)}"
+            )
+
+        # (2) precondition partition (reference L137-145)
+        passed: List[Analyzer] = []
+        failure_ctx = AnalyzerContext.empty()
+        for analyzer in remaining:
+            exc = find_first_failing(data.schema, analyzer.preconditions())
+            if exc is None:
+                passed.append(analyzer)
+            else:
+                failure_ctx.metric_map[analyzer] = analyzer.to_failure_metric(exc)
+
+        # (3) split (reference L148-153)
+        grouping = [a for a in passed if _is_grouping_shared(a)]
+        scanning = [
+            a
+            for a in passed
+            if isinstance(a, ScanShareableAnalyzer) and not _is_grouping_shared(a)
+        ]
+        own_pass = [a for a in passed if a not in grouping and a not in scanning]
+
+        # (4) one fused scan for all shareable analyzers (reference L289-336)
+        scan_ctx = AnalysisRunner._run_scanning_analyzers(
+            data, scanning, aggregate_with, save_states_with
+        )
+
+        # own-pass analyzers (KLL extra pass analogue, reference L155-160)
+        own_ctx = AnalyzerContext.empty()
+        for analyzer in own_pass:
+            own_ctx.metric_map[analyzer] = analyzer.calculate(
+                data, aggregate_with, save_states_with
+            )
+
+        # (5) grouping analyzers share one frequency table per distinct
+        # sorted grouping-column set (reference L175-190)
+        group_ctx = AnalyzerContext.empty()
+        by_grouping: Dict[Tuple[str, ...], List[FrequencyBasedAnalyzer]] = {}
+        for analyzer in grouping:
+            key = tuple(sorted(analyzer.group_columns))
+            by_grouping.setdefault(key, []).append(analyzer)
+        for group_key, group_analyzers in by_grouping.items():
+            group_ctx += AnalysisRunner._run_grouping_analyzers(
+                data, list(group_key), group_analyzers, aggregate_with, save_states_with
+            )
+
+        result = (
+            results_loaded + failure_ctx + scan_ctx + own_ctx + group_ctx
+        )
+
+        # (6) save to repository (reference L192-202)
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            from deequ_tpu.repository import AnalysisResult
+
+            existing = metrics_repository.load_by_key(save_or_append_results_with_key)
+            combined = (
+                (existing.analyzer_context + result)
+                if existing is not None
+                else result
+            )
+            metrics_repository.save(
+                AnalysisResult(save_or_append_results_with_key, combined)
+            )
+
+        return result
+
+    @staticmethod
+    def _run_scanning_analyzers(
+        data: ColumnarTable,
+        analyzers: Sequence[ScanShareableAnalyzer],
+        aggregate_with=None,
+        save_states_with=None,
+    ) -> AnalyzerContext:
+        if not analyzers:
+            return AnalyzerContext.empty()
+        ctx = AnalyzerContext.empty()
+        # per-analyzer op construction errors (e.g. a malformed where
+        # expression) fail only that analyzer, not the whole scan
+        ops = []
+        scannable = []
+        for analyzer in analyzers:
+            try:
+                ops.append(analyzer.scan_op(data))
+                scannable.append(analyzer)
+            except Exception as e:  # noqa: BLE001
+                ctx.metric_map[analyzer] = analyzer.to_failure_metric(
+                    wrap_if_necessary(e)
+                )
+        if not scannable:
+            return ctx
+        try:
+            results = run_scan(data, ops)
+        except Exception as e:  # noqa: BLE001 — a failure inside the shared
+            # scan maps onto every participating analyzer (reference L320-323)
+            wrapped = wrap_if_necessary(e)
+            for a in scannable:
+                ctx.metric_map[a] = a.to_failure_metric(wrapped)
+            return ctx
+        for analyzer, result in zip(scannable, results):
+            try:
+                state = analyzer.state_from_scan_result(result)
+            except Exception as e:  # noqa: BLE001
+                ctx.metric_map[analyzer] = analyzer.to_failure_metric(
+                    wrap_if_necessary(e)
+                )
+                continue
+            ctx.metric_map[analyzer] = analyzer.calculate_metric(
+                state, aggregate_with, save_states_with
+            )
+        return ctx
+
+    @staticmethod
+    def _run_grouping_analyzers(
+        data: ColumnarTable,
+        grouping_columns: List[str],
+        analyzers: Sequence[FrequencyBasedAnalyzer],
+        aggregate_with=None,
+        save_states_with=None,
+    ) -> AnalyzerContext:
+        from deequ_tpu.ops.segment import group_counts
+
+        try:
+            freqs, num_rows = group_counts(data, grouping_columns)
+            state: Optional[State] = FrequenciesAndNumRows.from_dict(
+                grouping_columns, freqs, num_rows
+            )
+        except Exception as e:  # noqa: BLE001
+            wrapped = wrap_if_necessary(e)
+            return AnalyzerContext(
+                {a: a.to_failure_metric(wrapped) for a in analyzers}
+            )
+        ctx = AnalyzerContext.empty()
+        for analyzer in analyzers:
+            # each analyzer re-keys the shared state under its own column
+            # order for persistence (reference keys states per analyzer)
+            own_state = FrequenciesAndNumRows.from_dict(
+                grouping_columns, dict(state.frequencies), state.num_rows
+            )
+            ctx.metric_map[analyzer] = analyzer.calculate_metric(
+                own_state, aggregate_with, save_states_with
+            )
+        return ctx
+
+    @staticmethod
+    def run_on_aggregated_states(
+        schema: Schema,
+        analyzers: Sequence[Analyzer],
+        state_loaders: Sequence,
+        save_states_with=None,
+        metrics_repository=None,
+        save_or_append_results_with_key=None,
+    ) -> AnalyzerContext:
+        """Compute metrics purely from persisted states — no data scan
+        (reference AnalysisRunner.scala:385-460)."""
+        if not analyzers or not state_loaders:
+            return AnalyzerContext.empty()
+
+        passed: List[Analyzer] = []
+        ctx = AnalyzerContext.empty()
+        for analyzer in analyzers:
+            exc = find_first_failing(schema, analyzer.preconditions())
+            if exc is None:
+                passed.append(analyzer)
+            else:
+                ctx.metric_map[analyzer] = analyzer.to_failure_metric(exc)
+
+        for analyzer in passed:
+            merged: Optional[State] = None
+            try:
+                for loader in state_loaders:
+                    merged = merge_states(merged, loader.load(analyzer))
+                if save_states_with is not None and merged is not None:
+                    save_states_with.persist(analyzer, merged)
+                ctx.metric_map[analyzer] = analyzer.compute_metric_from(merged)
+            except Exception as e:  # noqa: BLE001
+                ctx.metric_map[analyzer] = analyzer.to_failure_metric(
+                    wrap_if_necessary(e)
+                )
+
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            from deequ_tpu.repository import AnalysisResult
+
+            existing = metrics_repository.load_by_key(save_or_append_results_with_key)
+            combined = (
+                (existing.analyzer_context + ctx) if existing is not None else ctx
+            )
+            metrics_repository.save(
+                AnalysisResult(save_or_append_results_with_key, combined)
+            )
+        return ctx

@@ -1,0 +1,32 @@
+"""History extraction helpers (reference anomalydetection/HistoryUtils.scala)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class DataPoint:
+    """A (time, optional metric value) pair."""
+
+    time: int
+    metric_value: Optional[float]
+
+
+def extract_metric_values(
+    metrics: Sequence[Tuple[int, Optional[object]]]
+) -> List[DataPoint]:
+    """(date, Optional[Metric]) pairs -> DataPoints, keeping only successful
+    double values (reference HistoryUtils.scala:24-46)."""
+    out = []
+    for time, metric in metrics:
+        value: Optional[float] = None
+        if metric is not None and getattr(metric, "value", None) is not None:
+            if metric.value.is_success:
+                try:
+                    value = float(metric.value.get())
+                except (TypeError, ValueError):
+                    value = None
+        out.append(DataPoint(time, value))
+    return out

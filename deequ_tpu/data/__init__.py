@@ -1,0 +1,3 @@
+from deequ_tpu.data.table import Column, ColumnarTable, DType, Schema
+
+__all__ = ["Column", "ColumnarTable", "DType", "Schema"]

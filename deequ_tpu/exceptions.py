@@ -1,0 +1,56 @@
+"""Exception hierarchy for metric calculation failures.
+
+Mirrors the reference semantics (analyzers/runners/MetricCalculationException.scala:19-78):
+failures during metric computation are *data* — they are captured inside
+``Metric.value`` rather than aborting a run.
+"""
+
+from __future__ import annotations
+
+
+class MetricCalculationException(Exception):
+    """Base class for anything that goes wrong while computing a metric."""
+
+
+class MetricCalculationRuntimeException(MetricCalculationException):
+    """Runtime failure during state/metric computation."""
+
+
+class MetricCalculationPreconditionException(MetricCalculationException):
+    """A precondition on the input schema was violated."""
+
+
+class NoSuchColumnException(MetricCalculationPreconditionException):
+    def __init__(self, column: str):
+        super().__init__(f"Input data does not include column {column}!")
+        self.column = column
+
+
+class WrongColumnTypeException(MetricCalculationPreconditionException):
+    pass
+
+
+class NoColumnsSpecifiedException(MetricCalculationPreconditionException):
+    pass
+
+
+class NumberOfSpecifiedColumnsException(MetricCalculationPreconditionException):
+    pass
+
+
+class IllegalAnalyzerParameterException(MetricCalculationPreconditionException):
+    def __init__(self, message: str):
+        super().__init__(f"Can't execute the analysis: {message}")
+
+
+class EmptyStateException(MetricCalculationRuntimeException):
+    pass
+
+
+def wrap_if_necessary(exception: BaseException) -> MetricCalculationException:
+    """Ensure an arbitrary error is a MetricCalculationException (reference L69)."""
+    if isinstance(exception, MetricCalculationException):
+        return exception
+    wrapped = MetricCalculationRuntimeException(str(exception))
+    wrapped.__cause__ = exception
+    return wrapped

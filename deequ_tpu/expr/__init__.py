@@ -1,0 +1,4 @@
+from deequ_tpu.expr.parser import parse_expression
+from deequ_tpu.expr.eval import compile_predicate, eval_expression
+
+__all__ = ["parse_expression", "compile_predicate", "eval_expression"]

@@ -1,0 +1,160 @@
+"""Group-by counts via dictionary codes + device segment reduction.
+
+The reference shuffles rows for ``GROUP BY`` (GroupingAnalyzers.scala:66-78).
+The TPU-native design avoids a shuffle entirely: every column is already
+dictionary-encoded, so a group key is a mixed-radix packing of per-column
+codes and the frequency table is one ``segment_sum`` of ones — a single
+device pass, with ``psum`` merging per-device count vectors across the mesh
+(this IS the monoid merge of the frequency state).
+
+For pathological key-space sizes (product of per-column cardinalities too
+large to materialize as a dense count vector) we fall back to host
+``np.unique`` over the packed keys, which is the sparse equivalent.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.ops.scan_engine import SCAN_STATS
+from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh
+
+# dense device count vectors are used up to this key-space size
+DENSE_KEYSPACE_LIMIT = 1 << 22
+
+
+def column_key_codes(col: Column) -> Tuple[np.ndarray, List]:
+    """Per-row integer codes (0 = null, 1..K = distinct values) + the
+    decoded distinct values in code order."""
+    if col.dtype == DType.STRING:
+        codes = col.codes.astype(np.int64) + 1
+        return codes, list(col.dictionary)
+    valid = col.values[col.mask]
+    uniques, inv = np.unique(valid, return_inverse=True)
+    codes = np.zeros(len(col), dtype=np.int64)
+    codes[col.mask] = inv + 1
+    if col.dtype == DType.BOOLEAN:
+        values = [bool(v) for v in uniques]
+    elif col.dtype == DType.INTEGRAL:
+        values = [int(v) for v in uniques]
+    else:
+        values = [float(v) for v in uniques]
+    return codes, values
+
+
+def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
+    """Count key occurrences on device; psum across the mesh if present.
+
+    ``keys`` may contain -1 for rows to ignore (filtered / padding); those
+    land in an extra trailing slot that is dropped.
+    """
+    n = len(keys)
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    padded = max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
+    if padded != n:
+        keys = np.concatenate([keys, np.full(padded - n, -1, dtype=np.int64)])
+
+    def count(k):
+        slot = jnp.where(k < 0, num_segments, k)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(slot, dtype=jnp.int64), slot, num_segments=num_segments + 1
+        )
+        if mesh is not None:
+            counts = jax.lax.psum(counts, ROW_AXIS)
+        return counts
+
+    if mesh is not None:
+        fn = jax.jit(
+            jax.shard_map(count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+        )
+    else:
+        fn = jax.jit(count)
+    counts = np.asarray(fn(keys))
+    return counts[:num_segments]
+
+
+def group_counts(
+    table: ColumnarTable,
+    columns: Sequence[str],
+    mesh=None,
+    require_any_non_null: bool = True,
+) -> Tuple[Dict[tuple, int], int]:
+    """Compute the frequency table for a set of grouping columns.
+
+    Returns ``(frequencies, num_rows)`` where frequencies maps a tuple of
+    group values (None = null) to its count and num_rows is the number of
+    rows with at least one non-null grouping column (reference
+    GroupingAnalyzers.scala:53-79).
+    """
+    if mesh is None:
+        mesh = current_mesh()
+    SCAN_STATS.grouping_passes += 1
+    SCAN_STATS.rows_scanned += table.num_rows
+
+    code_arrays = []
+    value_lists = []
+    for name in columns:
+        codes, values = column_key_codes(table[name])
+        code_arrays.append(codes)
+        value_lists.append(values)
+
+    radices = [len(v) + 1 for v in value_lists]
+
+    if require_any_non_null and len(columns) > 0:
+        any_non_null = np.zeros(table.num_rows, dtype=bool)
+        for codes in code_arrays:
+            any_non_null |= codes > 0
+        num_rows = int(any_non_null.sum())
+    else:
+        any_non_null = None
+        num_rows = table.num_rows
+
+    # Python-int product: mixed-radix packing into int64 silently wraps when
+    # the key space exceeds 2^63, so overflow must be checked BEFORE packing
+    keyspace = 1
+    for radix in radices:
+        keyspace *= radix
+
+    frequencies: Dict[tuple, int] = {}
+    if keyspace <= DENSE_KEYSPACE_LIMIT:
+        keys = np.zeros(table.num_rows, dtype=np.int64)
+        for codes, radix in zip(code_arrays, radices):
+            keys = keys * radix + codes
+        if any_non_null is not None:
+            keys = np.where(any_non_null, keys, -1)
+        counts = _device_bincount(keys, keyspace, mesh)
+        present = np.nonzero(counts)[0]
+        present_counts = counts[present]
+        for key, cnt in zip(present.tolist(), present_counts.tolist()):
+            digits = []
+            rest = key
+            for radix in reversed(radices):
+                digits.append(rest % radix)
+                rest //= radix
+            digits.reverse()
+            group = tuple(
+                None if d == 0 else value_lists[i][d - 1]
+                for i, d in enumerate(digits)
+            )
+            frequencies[group] = int(cnt)
+    else:
+        # sparse path for huge key spaces: unique over the code matrix rows —
+        # no packing, so no overflow regardless of cardinality product
+        matrix = np.stack(code_arrays, axis=1)
+        if any_non_null is not None:
+            matrix = matrix[any_non_null]
+        uniques, counts = np.unique(matrix, axis=0, return_counts=True)
+        for row, cnt in zip(uniques.tolist(), counts.tolist()):
+            group = tuple(
+                None if d == 0 else value_lists[i][d - 1]
+                for i, d in enumerate(row)
+            )
+            frequencies[group] = int(cnt)
+    return frequencies, num_rows

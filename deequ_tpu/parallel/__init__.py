@@ -1,0 +1,8 @@
+from deequ_tpu.parallel.mesh import (
+    current_mesh,
+    default_mesh,
+    set_mesh,
+    use_mesh,
+)
+
+__all__ = ["current_mesh", "default_mesh", "set_mesh", "use_mesh"]

@@ -1,0 +1,64 @@
+"""Device mesh management for row-sharded analysis.
+
+deequ's distribution contract (SURVEY.md §2.15) is: partitioned scan +
+monoid state merge + shuffle group-by + tree reduce. The TPU-native
+equivalent implemented here: rows are sharded over a 1-D ``jax.sharding.Mesh``
+axis (``"rows"``), per-device partial states are computed inside
+``shard_map``, and state merges ride ICI as XLA collectives
+(psum/pmin/pmax — see ops/scan_engine.py for the tagged merge).
+
+Multi-host scaling: the same mesh spans hosts under ``jax.distributed``;
+nothing in the engine distinguishes ICI from DCN — XLA routes collectives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+ROW_AXIS = "rows"
+
+_state = threading.local()
+
+
+def default_mesh() -> Optional[Mesh]:
+    """Mesh over all visible devices (None when single-device)."""
+    devices = jax.devices()
+    if len(devices) <= 1:
+        return None
+    import numpy as np
+
+    return Mesh(np.array(devices), (ROW_AXIS,))
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The mesh the scan engine should use for this thread.
+
+    Resolution: explicitly set mesh (set_mesh/use_mesh) > default (all
+    devices if more than one, else single-device execution).
+    """
+    explicit = getattr(_state, "mesh", "unset")
+    if explicit != "unset":
+        return explicit
+    return default_mesh()
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    _state.mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    prev = getattr(_state, "mesh", "unset")
+    _state.mesh = mesh
+    try:
+        yield
+    finally:
+        if prev == "unset":
+            del _state.mesh
+        else:
+            _state.mesh = prev

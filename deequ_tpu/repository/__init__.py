@@ -1,0 +1,25 @@
+"""Metrics repository — time-series store for analysis results
+(reference layer L9, repository/).
+
+Results are keyed by ``ResultKey(data_set_date, tags)`` and queried through
+a small DSL (``load().with_tag_values(...).after(...).for_analyzers(...)``)
+— the substrate for anomaly detection over metric history.
+"""
+
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+from deequ_tpu.repository.memory import InMemoryMetricsRepository
+from deequ_tpu.repository.fs import FileSystemMetricsRepository
+
+__all__ = [
+    "AnalysisResult",
+    "MetricsRepository",
+    "MetricsRepositoryMultipleResultsLoader",
+    "ResultKey",
+    "InMemoryMetricsRepository",
+    "FileSystemMetricsRepository",
+]

@@ -1,0 +1,71 @@
+"""Filesystem metrics repository — one JSON file of all results, read-modify-
+write (reference repository/fs/FileSystemMetricsRepository.scala:32-226).
+Local paths play the role of HDFS/S3."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from deequ_tpu.repository import serde
+from deequ_tpu.repository.base import (
+    AnalysisResult,
+    MetricsRepository,
+    MetricsRepositoryMultipleResultsLoader,
+    ResultKey,
+)
+from deequ_tpu.analyzers.runner import AnalyzerContext
+
+
+class FileSystemMetricsRepository(MetricsRepository):
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def _read_all(self) -> List[AnalysisResult]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            text = f.read()
+        if not text.strip():
+            return []
+        return serde.deserialize(text)
+
+    def _write_all(self, results: List[AnalysisResult]) -> None:
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self.path, "w") as f:
+            f.write(serde.serialize(results))
+
+    def save(self, result: AnalysisResult) -> None:
+        successful = AnalyzerContext(
+            {
+                a: m
+                for a, m in result.analyzer_context.metric_map.items()
+                if m.value.is_success
+            }
+        )
+        to_save = AnalysisResult(result.result_key, successful)
+        with self._lock:
+            existing = self._read_all()
+            existing = [
+                r for r in existing if r.result_key != result.result_key
+            ]
+            existing.append(to_save)
+            self._write_all(existing)
+
+    def load_by_key(self, result_key: ResultKey) -> Optional[AnalysisResult]:
+        with self._lock:
+            for r in self._read_all():
+                if r.result_key == result_key:
+                    return r
+        return None
+
+    def load(self) -> MetricsRepositoryMultipleResultsLoader:
+        def provider() -> List[AnalysisResult]:
+            with self._lock:
+                return self._read_all()
+
+        return MetricsRepositoryMultipleResultsLoader(provider)

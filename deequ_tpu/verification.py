@@ -1,0 +1,339 @@
+"""VerificationSuite — the flagship entry point (reference layer L7,
+VerificationSuite.scala, VerificationRunBuilder.scala, VerificationResult.scala).
+
+    result = (VerificationSuite.on_data(table)
+              .add_check(Check(CheckLevel.ERROR, "tests")
+                         .is_complete("id")
+                         .has_size(lambda n: n >= 100))
+              .run())
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.analyzers.runner import AnalysisRunner, AnalyzerContext
+from deequ_tpu.checks import Check, CheckLevel, CheckResult, CheckStatus
+from deequ_tpu.constraints import ConstraintStatus
+from deequ_tpu.data.table import ColumnarTable, Schema
+from deequ_tpu.metrics import Metric
+
+
+@dataclass
+class VerificationResult:
+    """(reference VerificationResult.scala:33-119)"""
+
+    status: CheckStatus
+    check_results: Dict[Check, CheckResult]
+    metrics: Dict[Analyzer, Metric]
+
+    @staticmethod
+    def success_metrics_as_rows(
+        result: "VerificationResult",
+        for_analyzers: Optional[Sequence[Analyzer]] = None,
+    ) -> List[dict]:
+        ctx = AnalyzerContext(result.metrics)
+        return AnalyzerContext.success_metrics_as_rows(ctx, for_analyzers)
+
+    @staticmethod
+    def success_metrics_as_json(
+        result: "VerificationResult",
+        for_analyzers: Optional[Sequence[Analyzer]] = None,
+    ) -> str:
+        return json.dumps(VerificationResult.success_metrics_as_rows(result, for_analyzers))
+
+    @staticmethod
+    def check_results_as_rows(result: "VerificationResult") -> List[dict]:
+        rows = []
+        for check, check_result in result.check_results.items():
+            for cr in check_result.constraint_results:
+                rows.append(
+                    {
+                        "check": check.description,
+                        "check_level": check.level.value,
+                        "check_status": check_result.status.value,
+                        "constraint": str(cr.constraint),
+                        "constraint_status": cr.status.value,
+                        "constraint_message": cr.message or "",
+                    }
+                )
+        return rows
+
+    @staticmethod
+    def check_results_as_json(result: "VerificationResult") -> str:
+        return json.dumps(VerificationResult.check_results_as_rows(result))
+
+
+class VerificationSuite:
+    """(reference VerificationSuite.scala:49-315)"""
+
+    @staticmethod
+    def on_data(data: ColumnarTable) -> "VerificationRunBuilder":
+        return VerificationRunBuilder(data)
+
+    @staticmethod
+    def run(
+        data: ColumnarTable,
+        checks: Sequence[Check],
+        required_analyzers: Sequence[Analyzer] = (),
+    ) -> VerificationResult:
+        return VerificationSuite.do_verification_run(data, checks, required_analyzers)
+
+    @staticmethod
+    def do_verification_run(
+        data: ColumnarTable,
+        checks: Sequence[Check],
+        required_analyzers: Sequence[Analyzer] = (),
+        aggregate_with=None,
+        save_states_with=None,
+        metrics_repository=None,
+        reuse_existing_results_for_key=None,
+        fail_if_results_missing: bool = False,
+        save_or_append_results_with_key=None,
+        save_check_results_json_path: Optional[str] = None,
+        save_success_metrics_json_path: Optional[str] = None,
+        overwrite_output_files: bool = False,
+    ) -> VerificationResult:
+        analyzers = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+        # de-dup preserving order (reference unions into a Set)
+        seen = set()
+        unique_analyzers = []
+        for a in analyzers:
+            if a not in seen:
+                seen.add(a)
+                unique_analyzers.append(a)
+
+        analysis_context = AnalysisRunner.do_analysis_run(
+            data,
+            unique_analyzers,
+            aggregate_with=aggregate_with,
+            save_states_with=save_states_with,
+            metrics_repository=metrics_repository,
+            reuse_existing_results_for_key=reuse_existing_results_for_key,
+            fail_if_results_missing=fail_if_results_missing,
+        )
+
+        # evaluate BEFORE appending the new result: anomaly constraints query
+        # the repository history, which must not yet contain this run
+        # (reference VerificationSuite.scala evaluates at L263-281, then saves
+        # at L174-193)
+        result = VerificationSuite._evaluate(checks, analysis_context)
+
+        if metrics_repository is not None and save_or_append_results_with_key is not None:
+            from deequ_tpu.repository import AnalysisResult
+
+            existing = metrics_repository.load_by_key(save_or_append_results_with_key)
+            combined = (
+                (existing.analyzer_context + analysis_context)
+                if existing is not None
+                else analysis_context
+            )
+            metrics_repository.save(
+                AnalysisResult(save_or_append_results_with_key, combined)
+            )
+
+        VerificationSuite._save_json_outputs(
+            result,
+            save_check_results_json_path,
+            save_success_metrics_json_path,
+            overwrite_output_files,
+        )
+        return result
+
+    @staticmethod
+    def run_on_aggregated_states(
+        schema: Schema,
+        checks: Sequence[Check],
+        state_loaders: Sequence,
+        required_analyzers: Sequence[Analyzer] = (),
+        save_states_with=None,
+        metrics_repository=None,
+        save_or_append_results_with_key=None,
+    ) -> VerificationResult:
+        """Verification purely from persisted states — no data scan
+        (reference VerificationSuite.scala:208-229)."""
+        analyzers = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+        seen = set()
+        unique_analyzers = []
+        for a in analyzers:
+            if a not in seen:
+                seen.add(a)
+                unique_analyzers.append(a)
+        ctx = AnalysisRunner.run_on_aggregated_states(
+            schema,
+            unique_analyzers,
+            state_loaders,
+            save_states_with=save_states_with,
+            metrics_repository=metrics_repository,
+            save_or_append_results_with_key=save_or_append_results_with_key,
+        )
+        return VerificationSuite._evaluate(checks, ctx)
+
+    @staticmethod
+    def _evaluate(
+        checks: Sequence[Check], analysis_context: AnalyzerContext
+    ) -> VerificationResult:
+        """(reference VerificationSuite.scala:263-281)"""
+        check_results = {c: c.evaluate(analysis_context) for c in checks}
+        if not check_results:
+            status = CheckStatus.SUCCESS
+        else:
+            status = max(
+                (r.status for r in check_results.values()),
+                key=lambda s: s.severity,
+            )
+        return VerificationResult(status, check_results, dict(analysis_context.metric_map))
+
+    @staticmethod
+    def _save_json_outputs(
+        result: VerificationResult,
+        check_results_path: Optional[str],
+        success_metrics_path: Optional[str],
+        overwrite: bool,
+    ) -> None:
+        for path, payload in (
+            (check_results_path, lambda: VerificationResult.check_results_as_json(result)),
+            (success_metrics_path, lambda: VerificationResult.success_metrics_as_json(result)),
+        ):
+            if path is None:
+                continue
+            if os.path.exists(path) and not overwrite:
+                continue
+            with open(path, "w") as f:
+                f.write(payload())
+
+
+@dataclass(frozen=True)
+class AnomalyCheckConfig:
+    """(reference VerificationRunBuilder.scala:336-341)"""
+
+    level: CheckLevel
+    description: str
+    with_tag_values: dict = field(default_factory=dict)
+    after_date: Optional[int] = None
+    before_date: Optional[int] = None
+
+
+class VerificationRunBuilder:
+    """Fluent configuration (reference VerificationRunBuilder.scala:28-182)."""
+
+    def __init__(self, data: ColumnarTable):
+        self._data = data
+        self._checks: List[Check] = []
+        self._required_analyzers: List[Analyzer] = []
+        self._aggregate_with = None
+        self._save_states_with = None
+        self._metrics_repository = None
+        self._reuse_key = None
+        self._fail_if_results_missing = False
+        self._save_key = None
+        self._check_results_path: Optional[str] = None
+        self._success_metrics_path: Optional[str] = None
+        self._overwrite_output_files = False
+
+    def add_check(self, check: Check) -> "VerificationRunBuilder":
+        self._checks.append(check)
+        return self
+
+    def add_checks(self, checks: Sequence[Check]) -> "VerificationRunBuilder":
+        self._checks.extend(checks)
+        return self
+
+    def add_required_analyzer(self, analyzer: Analyzer) -> "VerificationRunBuilder":
+        self._required_analyzers.append(analyzer)
+        return self
+
+    def add_required_analyzers(self, analyzers) -> "VerificationRunBuilder":
+        self._required_analyzers.extend(analyzers)
+        return self
+
+    def aggregate_with(self, state_loader) -> "VerificationRunBuilder":
+        self._aggregate_with = state_loader
+        return self
+
+    def save_states_with(self, state_persister) -> "VerificationRunBuilder":
+        self._save_states_with = state_persister
+        return self
+
+    def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
+        self._check_results_path = path
+        return self
+
+    def save_success_metrics_json_to_path(self, path: str) -> "VerificationRunBuilder":
+        self._success_metrics_path = path
+        return self
+
+    def overwrite_previous_files(self, overwrite: bool) -> "VerificationRunBuilder":
+        # reference has a self-assignment bug here (VerificationRunBuilder.
+        # scala:287); we implement the intended behavior
+        self._overwrite_output_files = overwrite
+        return self
+
+    def use_repository(self, repository) -> "VerificationRunBuilderWithRepository":
+        return VerificationRunBuilderWithRepository(self, repository)
+
+    def run(self) -> VerificationResult:
+        return VerificationSuite.do_verification_run(
+            self._data,
+            self._checks,
+            self._required_analyzers,
+            aggregate_with=self._aggregate_with,
+            save_states_with=self._save_states_with,
+            metrics_repository=self._metrics_repository,
+            reuse_existing_results_for_key=self._reuse_key,
+            fail_if_results_missing=self._fail_if_results_missing,
+            save_or_append_results_with_key=self._save_key,
+            save_check_results_json_path=self._check_results_path,
+            save_success_metrics_json_path=self._success_metrics_path,
+            overwrite_output_files=self._overwrite_output_files,
+        )
+
+
+class VerificationRunBuilderWithRepository(VerificationRunBuilder):
+    """(reference VerificationRunBuilder.scala:184-244)"""
+
+    def __init__(self, base: VerificationRunBuilder, repository):
+        super().__init__(base._data)
+        self.__dict__.update(base.__dict__)
+        self._metrics_repository = repository
+
+    def reuse_existing_results_for_key(
+        self, result_key, fail_if_results_missing: bool = False
+    ) -> "VerificationRunBuilderWithRepository":
+        self._reuse_key = result_key
+        self._fail_if_results_missing = fail_if_results_missing
+        return self
+
+    def save_or_append_result(self, result_key) -> "VerificationRunBuilderWithRepository":
+        self._save_key = result_key
+        return self
+
+    def add_anomaly_check(
+        self,
+        anomaly_detection_strategy,
+        analyzer: Analyzer,
+        anomaly_check_config: Optional[AnomalyCheckConfig] = None,
+    ) -> "VerificationRunBuilderWithRepository":
+        """(reference VerificationRunBuilder.scala:227-243)"""
+        config = anomaly_check_config or AnomalyCheckConfig(
+            CheckLevel.WARNING,
+            f"Anomaly check for {analyzer!r}",
+        )
+        check = Check(config.level, config.description).is_newest_point_non_anomalous(
+            self._metrics_repository,
+            anomaly_detection_strategy,
+            analyzer,
+            config.with_tag_values,
+            config.after_date,
+            config.before_date,
+        )
+        self._checks.append(check)
+        return self

@@ -1,0 +1,104 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+This is the analogue of the reference's local Spark session with 2 shuffle
+partitions (SparkContextSpec.scala:25-97): the full multi-device code path
+(shard_map + collectives) executes on 8 virtual CPU devices, so the
+distributed state algebra is exercised in every test.
+
+NOTE: must run before any jax import; the environment's sitecustomize pins
+JAX_PLATFORMS=axon (the TPU tunnel), which we override for tests.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from deequ_tpu.data.table import ColumnarTable  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_scan_stats():
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.reset()
+    yield
+
+
+# -- fixture tables (the analogue of utils/FixtureSupport.scala:26-259) -----
+
+
+@pytest.fixture
+def df_full() -> ColumnarTable:
+    return ColumnarTable.from_pydict(
+        {
+            "item": ["1", "2", "3", "4"],
+            "att1": ["a", "b", "a", "a"],
+            "att2": ["c", "d", "d", "f"],
+        }
+    )
+
+
+@pytest.fixture
+def df_missing() -> ColumnarTable:
+    return ColumnarTable.from_pydict(
+        {
+            "item": [str(i) for i in range(1, 13)],
+            "att1": ["a", None, "a", "a", "b", None, "a", "b", "a", None, "a", "a"],
+            "att2": ["f", "d", None, "f", None, "f", None, "d", "f", None, "f", "d"],
+        }
+    )
+
+
+@pytest.fixture
+def df_with_numeric_values() -> ColumnarTable:
+    return ColumnarTable.from_pydict(
+        {
+            "item": ["1", "2", "3", "4", "5", "6"],
+            "att1": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+            "att2": [0.0, 0.0, 0.0, 5.0, 6.0, 7.0],
+        }
+    )
+
+
+@pytest.fixture
+def df_with_unique_columns() -> ColumnarTable:
+    return ColumnarTable.from_pydict(
+        {
+            "unique": ["1", "2", "3", "4", "5", "6"],
+            "nonUnique": ["0", "0", "0", "5", "6", "7"],
+            "nonUniqueWithNulls": ["1", None, "1", None, None, "2"],
+            "uniqueWithNulls": ["1", "2", None, "4", "5", "6"],
+            "onlyUniqueWithOtherNonUnique": ["1", "2", "3", "4", "5", "6"],
+            "halfUniqueCombinedWithNonUnique": ["0", "1", "1", "2", "3", "4"],
+        }
+    )
+
+
+@pytest.fixture
+def df_with_distinct_values() -> ColumnarTable:
+    return ColumnarTable.from_pydict(
+        {
+            "att1": ["a", "a", None, "b", "b", "c"],
+            "att2": ["f", "d", "d", "d", None, "e"],
+        }
+    )
+
+
+@pytest.fixture
+def df_with_strings_and_numbers() -> ColumnarTable:
+    return ColumnarTable.from_pydict(
+        {
+            "mixed": ["1", "2.0", "foo", "true", None, "3"],
+            "ints": ["1", "2", "3", "4", "5", "6"],
+        }
+    )

@@ -1,0 +1,167 @@
+"""Anomaly strategy tests on synthetic series with exact index assertions
+(analogue of anomalydetection/*Test.scala, seasonal/HoltWintersTest.scala)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deequ_tpu.anomaly import (
+    AbsoluteChangeStrategy,
+    AnomalyDetector,
+    BatchNormalStrategy,
+    DataPoint,
+    HoltWinters,
+    MetricInterval,
+    OnlineNormalStrategy,
+    RelativeRateOfChangeStrategy,
+    SeriesSeasonality,
+    SimpleThresholdStrategy,
+)
+
+
+def test_simple_threshold():
+    data = [-1.0, 2.0, 3.0, 0.5]
+    found = SimpleThresholdStrategy(upper_bound=1.0).detect(data, (0, 4))
+    assert [i for i, _ in found] == [1, 2]
+    assert found[0][1].value == 2.0
+
+
+def test_simple_threshold_interval():
+    data = [-1.0, 2.0, 3.0, 0.5]
+    found = SimpleThresholdStrategy(upper_bound=1.0).detect(data, (2, 4))
+    assert [i for i, _ in found] == [2]
+
+
+def test_absolute_change():
+    # jump of +10 at index 5
+    data = [1.0, 2.0, 3.0, 4.0, 5.0, 15.0, 16.0]
+    found = AbsoluteChangeStrategy(max_rate_decrease=-2.0, max_rate_increase=2.0).detect(
+        data, (0, len(data))
+    )
+    assert [i for i, _ in found] == [5]
+
+
+def test_absolute_change_second_order():
+    data = [1.0, 2.0, 4.0, 8.0, 16.0]  # second differences: 1, 2, 4
+    found = AbsoluteChangeStrategy(
+        max_rate_decrease=-3.0, max_rate_increase=3.0, order=2
+    ).detect(data, (0, len(data)))
+    assert [i for i, _ in found] == [4]
+
+
+def test_relative_rate_of_change():
+    data = [1.0, 1.1, 1.2, 6.0, 6.1]
+    found = RelativeRateOfChangeStrategy(
+        max_rate_decrease=0.5, max_rate_increase=2.0
+    ).detect(data, (0, len(data)))
+    assert [i for i, _ in found] == [3]
+
+
+def test_online_normal():
+    rng = np.random.default_rng(42)
+    data = rng.normal(1.0, 0.1, 100).tolist()
+    data[77] = 10.0
+    found = OnlineNormalStrategy().detect(data, (0, len(data)))
+    assert 77 in [i for i, _ in found]
+
+
+def test_batch_normal():
+    rng = np.random.default_rng(0)
+    data = rng.normal(0.0, 1.0, 50).tolist() + [25.0, 0.1]
+    found = BatchNormalStrategy().detect(data, (50, 52))
+    assert [i for i, _ in found] == [50]
+
+
+def test_batch_normal_requires_training_data():
+    with pytest.raises(ValueError):
+        BatchNormalStrategy().detect([1.0, 2.0], (0, 2))
+
+
+def test_detector_sorts_and_drops_missing():
+    strategy = SimpleThresholdStrategy(upper_bound=1.0)
+    detector = AnomalyDetector(strategy)
+    series = [
+        DataPoint(3, 5.0),
+        DataPoint(1, 0.5),
+        DataPoint(2, None),  # dropped
+    ]
+    result = detector.detect_anomalies_in_history(series)
+    assert [(t, a.value) for t, a in result.anomalies] == [(3, 5.0)]
+
+
+def test_is_new_point_anomalous():
+    strategy = SimpleThresholdStrategy(upper_bound=1.0)
+    detector = AnomalyDetector(strategy)
+    history = [DataPoint(i, 0.5) for i in range(10)]
+    bad = detector.is_new_point_anomalous(history, DataPoint(11, 5.0))
+    assert len(bad.anomalies) == 1
+    good = detector.is_new_point_anomalous(history, DataPoint(11, 0.6))
+    assert len(good.anomalies) == 0
+    with pytest.raises(ValueError):
+        detector.is_new_point_anomalous(history, DataPoint(5, 1.0))
+
+
+def test_holt_winters_detects_seasonal_break():
+    # two sine-ish weekly cycles for training, then an off-pattern spike
+    period = 7
+    base = [10.0 + 5.0 * math.sin(2 * math.pi * i / period) for i in range(35)]
+    series = base[:28] + [base[28], base[29] + 40.0, base[30], base[31], base[32]]
+    hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+    found = hw.detect(series, (28, len(series)))
+    assert 29 in [i for i, _ in found]
+    assert 28 not in [i for i, _ in found]
+
+
+def test_holt_winters_requires_two_cycles():
+    hw = HoltWinters(MetricInterval.DAILY, SeriesSeasonality.WEEKLY)
+    with pytest.raises(ValueError):
+        hw.detect([1.0] * 20, (10, 20))
+
+
+def test_anomaly_check_integration(df_with_numeric_values):
+    """Full addAnomalyCheck flow against a repository history
+    (reference VerificationRunBuilder.scala:227-243)."""
+    from deequ_tpu import Check, CheckLevel, CheckStatus, VerificationSuite
+    from deequ_tpu.analyzers import Size
+    from deequ_tpu.repository import InMemoryMetricsRepository, ResultKey
+    from deequ_tpu.verification import AnomalyCheckConfig
+
+    repo = InMemoryMetricsRepository()
+    # history: sizes around 6
+    for day in range(1, 5):
+        (
+            VerificationSuite.on_data(df_with_numeric_values)
+            .use_repository(repo)
+            .save_or_append_result(ResultKey(day))
+            .add_required_analyzer(Size())
+            .run()
+        )
+    # new run with similar size -> not anomalous
+    result = (
+        VerificationSuite.on_data(df_with_numeric_values)
+        .use_repository(repo)
+        .save_or_append_result(ResultKey(10))
+        .add_anomaly_check(
+            RelativeRateOfChangeStrategy(max_rate_decrease=0.5, max_rate_increase=2.0),
+            Size(),
+            AnomalyCheckConfig(CheckLevel.WARNING, "size anomaly"),
+        )
+        .run()
+    )
+    assert result.status == CheckStatus.SUCCESS
+
+    # drastically smaller dataset -> anomalous
+    small = df_with_numeric_values.head(1)
+    result2 = (
+        VerificationSuite.on_data(small)
+        .use_repository(repo)
+        .save_or_append_result(ResultKey(11))
+        .add_anomaly_check(
+            RelativeRateOfChangeStrategy(max_rate_decrease=0.5, max_rate_increase=2.0),
+            Size(),
+            AnomalyCheckConfig(CheckLevel.WARNING, "size anomaly"),
+        )
+        .run()
+    )
+    assert result2.status == CheckStatus.WARNING

@@ -1,0 +1,102 @@
+"""Expression DSL tests: parser + SQL three-valued evaluation semantics."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.data.table import ColumnarTable
+from deequ_tpu.expr.eval import eval_predicate_on_table
+from deequ_tpu.expr.parser import ExprSyntaxError, parse_expression
+
+
+@pytest.fixture
+def table():
+    return ColumnarTable.from_pydict(
+        {
+            "a": [1.0, 2.0, None, 4.0],
+            "b": [10.0, None, 30.0, 40.0],
+            "s": ["x", "y", None, "x"],
+        }
+    )
+
+
+def mask(expr, table):
+    return eval_predicate_on_table(expr, table).tolist()
+
+
+def test_comparisons(table):
+    assert mask("a > 1", table) == [False, True, False, True]
+    assert mask("a >= 2", table) == [False, True, False, True]
+    assert mask("a = 2", table) == [False, True, False, False]
+    assert mask("a != 2", table) == [True, False, False, True]
+
+
+def test_null_propagation(table):
+    # null comparisons are never true under WHERE semantics
+    assert mask("a < b", table) == [True, False, False, True]
+
+
+def test_is_null(table):
+    assert mask("a IS NULL", table) == [False, False, True, False]
+    assert mask("a IS NOT NULL", table) == [True, True, False, True]
+    assert mask("s IS NULL", table) == [False, False, True, False]
+
+
+def test_boolean_logic(table):
+    assert mask("a > 1 AND b > 20", table) == [False, False, False, True]
+    assert mask("a > 1 OR b > 20", table) == [False, True, True, True]
+    assert mask("NOT (a > 1)", table) == [True, False, False, False]
+
+
+def test_string_ops(table):
+    assert mask("s = 'x'", table) == [True, False, False, True]
+    assert mask("s IN ('x', 'y')", table) == [True, True, False, True]
+    assert mask("s LIKE 'x%'", table) == [True, False, False, True]
+    assert mask("s RLIKE '^[xy]$'", table) == [True, True, False, True]
+
+
+def test_arithmetic(table):
+    assert mask("a + 1 > 2", table) == [False, True, False, True]
+    assert mask("a * 10 = b", table) == [True, False, False, True]
+    assert mask("a % 2 = 0", table) == [False, True, False, True]
+
+
+def test_division_by_zero_is_null(table):
+    assert mask("a / 0 > 0", table) == [False, False, False, False]
+
+
+def test_between_and_coalesce(table):
+    assert mask("a BETWEEN 2 AND 4", table) == [False, True, False, True]
+    assert mask("COALESCE(a, 0.0) >= 0", table) == [True, True, True, True]
+    assert mask("COALESCE(a, -1) < 0", table) == [False, False, True, False]
+
+
+def test_length_function(table):
+    assert mask("length(s) = 1", table) == [True, True, False, True]
+
+
+def test_backquoted_columns(table):
+    assert mask("`a` > 1", table) == [False, True, False, True]
+
+
+def test_syntax_errors():
+    with pytest.raises(ExprSyntaxError):
+        parse_expression("a >")
+    with pytest.raises(ExprSyntaxError):
+        parse_expression("a ! b")
+    with pytest.raises(ExprSyntaxError):
+        parse_expression("(a > 1")
+
+
+def test_string_column_vs_string_column():
+    t = ColumnarTable.from_pydict(
+        {"a": ["x", "y", "z", None], "b": ["x", "q", "z", "z"]}
+    )
+    assert mask("a = b", t) == [True, False, True, False]
+    assert mask("a != b", t) == [False, True, False, False]
+    assert mask("a <= b", t) == [True, False, True, False]
+    assert mask("a > b", t) == [False, True, False, False]
+
+
+def test_quote_in_string_literal():
+    t = ColumnarTable.from_pydict({"name": ["O'Brien", "Smith"]})
+    assert mask(r"name = 'O\'Brien'", t) == [True, False]

@@ -1,0 +1,177 @@
+"""Incremental == batch equivalence tests — the core distributed-correctness
+property (analogue of IncrementalAnalysisTest.scala, StateAggregationTests.
+scala): running on `initial` saving states, then on `delta` aggregating with
+the saved states, must equal a full recompute on `initial ∪ delta`."""
+
+import math
+
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.data.table import ColumnarTable
+from deequ_tpu.states import FileSystemStateProvider, InMemoryStateProvider
+
+
+@pytest.fixture
+def initial():
+    return ColumnarTable.from_pydict(
+        {
+            "id": [1.0, 2.0, 3.0, 4.0],
+            "cat": ["a", "b", "a", None],
+            "val": [10.0, 20.0, None, 40.0],
+        }
+    )
+
+
+@pytest.fixture
+def delta():
+    return ColumnarTable.from_pydict(
+        {
+            "id": [5.0, 6.0, 7.0],
+            "cat": ["c", "a", "b"],
+            "val": [50.0, None, 70.0],
+        }
+    )
+
+
+ANALYZERS = [
+    Size(),
+    Completeness("val"),
+    Minimum("id"),
+    Maximum("id"),
+    Mean("val"),
+    Sum("val"),
+    StandardDeviation("id"),
+    DataType("cat"),
+    Uniqueness(("cat",)),
+    Distinctness(("cat",)),
+    CountDistinct(("cat",)),
+    Entropy("cat"),
+    ApproxCountDistinct("cat"),
+]
+
+
+def _values(ctx):
+    out = {}
+    for analyzer, metric in ctx.metric_map.items():
+        if metric.value.is_success:
+            v = metric.value.get()
+            out[repr(analyzer)] = v if isinstance(v, float) else repr(v)
+        else:
+            out[repr(analyzer)] = "FAILURE"
+    return out
+
+
+def test_incremental_equals_batch(initial, delta):
+    states = InMemoryStateProvider()
+    AnalysisRunner.do_analysis_run(initial, ANALYZERS, save_states_with=states)
+    incremental = AnalysisRunner.do_analysis_run(
+        delta, ANALYZERS, aggregate_with=states
+    )
+    batch = AnalysisRunner.do_analysis_run(initial.concat(delta), ANALYZERS)
+    inc_vals = _values(incremental)
+    batch_vals = _values(batch)
+    for key in batch_vals:
+        bv, iv = batch_vals[key], inc_vals[key]
+        if isinstance(bv, float) and isinstance(iv, float):
+            assert math.isclose(bv, iv, rel_tol=1e-9, abs_tol=1e-9), (
+                f"{key}: batch={bv} incremental={iv}"
+            )
+        else:
+            assert bv == iv, f"{key}: batch={bv} incremental={iv}"
+
+
+def test_run_on_aggregated_states(initial, delta):
+    """Metrics purely from persisted states, no rescan (reference
+    AnalysisRunner.runOnAggregatedStates, VerificationSuite.scala:208-229)."""
+    states_a = InMemoryStateProvider()
+    states_b = InMemoryStateProvider()
+    AnalysisRunner.do_analysis_run(initial, ANALYZERS, save_states_with=states_a)
+    AnalysisRunner.do_analysis_run(delta, ANALYZERS, save_states_with=states_b)
+    from_states = AnalysisRunner.run_on_aggregated_states(
+        initial.schema, ANALYZERS, [states_a, states_b]
+    )
+    batch = AnalysisRunner.do_analysis_run(initial.concat(delta), ANALYZERS)
+    sv, bv = _values(from_states), _values(batch)
+    for key in bv:
+        if isinstance(bv[key], float) and isinstance(sv[key], float):
+            assert math.isclose(bv[key], sv[key], rel_tol=1e-9, abs_tol=1e-9), key
+        else:
+            assert bv[key] == sv[key], key
+
+
+def test_partition_update_workflow(initial, delta):
+    """Replace one partition's state and recompute without rescanning the
+    others (reference UpdateMetricsOnPartitionedDataExample)."""
+    part_states = {
+        "p1": InMemoryStateProvider(),
+        "p2": InMemoryStateProvider(),
+    }
+    analyzers = [Size(), Mean("val")]
+    AnalysisRunner.do_analysis_run(initial, analyzers, save_states_with=part_states["p1"])
+    AnalysisRunner.do_analysis_run(delta, analyzers, save_states_with=part_states["p2"])
+    combined = AnalysisRunner.run_on_aggregated_states(
+        initial.schema, analyzers, list(part_states.values())
+    )
+    assert combined.metric_map[Size()].value.get() == 7.0
+
+    # "update" partition 2 with new data
+    new_delta = ColumnarTable.from_pydict(
+        {"id": [8.0], "cat": ["z"], "val": [100.0]}
+    )
+    AnalysisRunner.do_analysis_run(
+        new_delta, analyzers, save_states_with=part_states["p2"]
+    )
+    updated = AnalysisRunner.run_on_aggregated_states(
+        initial.schema, analyzers, list(part_states.values())
+    )
+    assert updated.metric_map[Size()].value.get() == 5.0
+    expected_mean = (10.0 + 20.0 + 40.0 + 100.0) / 4
+    assert math.isclose(updated.metric_map[Mean("val")].value.get(), expected_mean)
+
+
+def test_state_roundtrip_filesystem(tmp_path, initial):
+    """State persist -> load -> identical metric, for every analyzer type
+    (analogue of StateProviderTest.scala)."""
+    fs = FileSystemStateProvider(str(tmp_path / "states"))
+    AnalysisRunner.do_analysis_run(initial, ANALYZERS, save_states_with=fs)
+    from_states = AnalysisRunner.run_on_aggregated_states(
+        initial.schema, ANALYZERS, [fs]
+    )
+    direct = AnalysisRunner.do_analysis_run(initial, ANALYZERS)
+    dv, sv = _values(direct), _values(from_states)
+    for key in dv:
+        assert dv[key] == sv[key] or (
+            isinstance(dv[key], float)
+            and isinstance(sv[key], float)
+            and math.isclose(dv[key], sv[key], rel_tol=1e-9)
+        ), key
+
+
+def test_kll_incremental(initial, delta):
+    """KLL sketch states merge across partitions."""
+    states = InMemoryStateProvider()
+    analyzers = [KLLSketch("id")]
+    AnalysisRunner.do_analysis_run(initial, analyzers, save_states_with=states)
+    inc = AnalysisRunner.do_analysis_run(delta, analyzers, aggregate_with=states)
+    dist = inc.metric_map[KLLSketch("id")].value.get()
+    assert sum(b.count for b in dist.buckets) == 7
+    assert dist.buckets[0].low_value == 1.0
+    assert dist.buckets[-1].high_value == 7.0

@@ -1,0 +1,192 @@
+"""Repository tests: serde round-trip identity for every analyzer/metric type
+(analogue of AnalysisResultSerdeTest.scala) + behavior spec run against both
+repository implementations + query DSL."""
+
+import math
+
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    ApproxQuantile,
+    ApproxQuantiles,
+    Completeness,
+    Compliance,
+    Correlation,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    MaxLength,
+    Mean,
+    Minimum,
+    MinLength,
+    MutualInformation,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner, AnalyzerContext
+from deequ_tpu.data.table import ColumnarTable
+from deequ_tpu.metrics import DoubleMetric, Entity
+from deequ_tpu.repository import (
+    AnalysisResult,
+    FileSystemMetricsRepository,
+    InMemoryMetricsRepository,
+    ResultKey,
+)
+from deequ_tpu.repository import serde
+from deequ_tpu.tryresult import Success
+
+
+ALL_ANALYZERS = [
+    Size(),
+    Size(where="x > 2"),
+    Completeness("col"),
+    Completeness("col", "x > 1"),
+    Compliance("rule", "x > 3"),
+    PatternMatch("col", r"\d+"),
+    Minimum("col"),
+    Maximum("col"),
+    MinLength("col"),
+    MaxLength("col"),
+    Mean("col"),
+    Sum("col"),
+    StandardDeviation("col"),
+    Correlation("a", "b"),
+    DataType("col"),
+    ApproxCountDistinct("col"),
+    ApproxQuantile("col", 0.5),
+    ApproxQuantiles("col", [0.25, 0.5]),
+    KLLSketch("col"),
+    KLLSketch("col", KLLParameters(1024, 0.5, 50)),
+    Uniqueness(("a", "b")),
+    UniqueValueRatio(("a",)),
+    Distinctness(("a",)),
+    CountDistinct(("a", "b")),
+    Entropy("col"),
+    MutualInformation("a", "b"),
+    Histogram("col"),
+]
+
+
+def test_analyzer_serde_roundtrip_identity():
+    for analyzer in ALL_ANALYZERS:
+        data = serde.analyzer_to_json(analyzer)
+        back = serde.analyzer_from_json(data)
+        assert back == analyzer, f"{analyzer!r} -> {data} -> {back!r}"
+
+
+def test_full_result_serde_roundtrip(df_with_numeric_values):
+    analyzers = [
+        Size(), Completeness("att1"), Mean("att1"), DataType("att1"),
+        Uniqueness(("att1",)), KLLSketch("att1"), ApproxQuantiles("att1", [0.5]),
+        Histogram("att1"),
+    ]
+    ctx = AnalysisRunner.do_analysis_run(df_with_numeric_values, analyzers)
+    result = AnalysisResult(ResultKey(12345, {"region": "EU"}), ctx)
+    text = serde.serialize([result])
+    [back] = serde.deserialize(text)
+    assert back.result_key == result.result_key
+    assert set(back.analyzer_context.metric_map) == set(ctx.metric_map)
+    for analyzer, metric in ctx.metric_map.items():
+        restored = back.analyzer_context.metric_map[analyzer]
+        assert type(restored) is type(metric)
+        assert restored.value.is_success == metric.value.is_success
+
+
+@pytest.fixture(params=["memory", "fs"])
+def repository(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryMetricsRepository()
+    return FileSystemMetricsRepository(str(tmp_path / "metrics.json"))
+
+
+def _make_result(date, tags, value):
+    metric = DoubleMetric(Entity.DATASET, "Size", "*", Success(value))
+    return AnalysisResult(
+        ResultKey(date, tags), AnalyzerContext({Size(): metric})
+    )
+
+
+def test_save_and_load_by_key(repository):
+    result = _make_result(100, {"env": "test"}, 5.0)
+    repository.save(result)
+    loaded = repository.load_by_key(ResultKey(100, {"env": "test"}))
+    assert loaded is not None
+    assert loaded.analyzer_context.metric_map[Size()].value.get() == 5.0
+    assert repository.load_by_key(ResultKey(999)) is None
+
+
+def test_save_overwrites_same_key(repository):
+    repository.save(_make_result(100, {}, 5.0))
+    repository.save(_make_result(100, {}, 7.0))
+    loaded = repository.load_by_key(ResultKey(100, {}))
+    assert loaded.analyzer_context.metric_map[Size()].value.get() == 7.0
+
+
+def test_query_dsl(repository):
+    repository.save(_make_result(100, {"env": "dev"}, 1.0))
+    repository.save(_make_result(200, {"env": "prod"}, 2.0))
+    repository.save(_make_result(300, {"env": "prod"}, 3.0))
+
+    assert len(repository.load().get()) == 3
+    assert len(repository.load().after(150).get()) == 2
+    assert len(repository.load().before(250).get()) == 2
+    assert len(repository.load().after(150).before(250).get()) == 1
+    prod = repository.load().with_tag_values({"env": "prod"}).get()
+    assert len(prod) == 2
+    filtered = repository.load().for_analyzers([Completeness("x")]).get()
+    assert all(len(r.analyzer_context.metric_map) == 0 for r in filtered)
+
+
+def test_query_rows_include_tags(repository):
+    repository.save(_make_result(100, {"env": "dev"}, 1.0))
+    rows = repository.load().get_success_metrics_as_rows()
+    assert rows[0]["dataset_date"] == 100
+    assert rows[0]["env"] == "dev"
+
+
+def test_repository_reuse_in_runner(df_with_numeric_values, repository):
+    key = ResultKey(42, {})
+    analyzers = [Size(), Mean("att1")]
+    ctx1 = AnalysisRunner.do_analysis_run(
+        df_with_numeric_values,
+        analyzers,
+        metrics_repository=repository,
+        save_or_append_results_with_key=key,
+    )
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    passes_before = SCAN_STATS.scan_passes
+    # second run should read everything from the repository: no new scan
+    ctx2 = AnalysisRunner.do_analysis_run(
+        df_with_numeric_values,
+        analyzers,
+        metrics_repository=repository,
+        reuse_existing_results_for_key=key,
+    )
+    assert SCAN_STATS.scan_passes == passes_before
+    assert ctx2.metric_map[Size()].value.get() == 6.0
+
+
+def test_fail_if_results_missing(df_with_numeric_values, repository):
+    from deequ_tpu.analyzers.runner import (
+        ReusingNotPossibleResultsMissingException,
+    )
+
+    with pytest.raises(ReusingNotPossibleResultsMissingException):
+        AnalysisRunner.do_analysis_run(
+            df_with_numeric_values,
+            [Size()],
+            metrics_repository=repository,
+            reuse_existing_results_for_key=ResultKey(1, {}),
+            fail_if_results_missing=True,
+        )

@@ -1,0 +1,91 @@
+"""Scan-fusion assertions via pass accounting — the analogue of the
+reference's SparkMonitor job-count tests (AnalysisRunnerTests.scala:51-120:
+6 shareable analyzers fused = 1 job; grouping analyzers = 2 jobs)."""
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    Compliance,
+    CountDistinct,
+    DataType,
+    Maximum,
+    Mean,
+    Minimum,
+    StandardDeviation,
+    Sum,
+    Size,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+
+def test_six_scan_shareable_analyzers_fuse_into_one_pass(df_with_numeric_values):
+    analyzers = [
+        Size(),
+        Completeness("att1"),
+        Minimum("att1"),
+        Maximum("att1"),
+        Mean("att1"),
+        StandardDeviation("att1"),
+    ]
+    ctx = AnalysisRunner.do_analysis_run(df_with_numeric_values, analyzers)
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.scan_passes == 1
+    assert SCAN_STATS.grouping_passes == 0
+
+
+def test_sketches_fuse_into_the_same_pass(df_with_numeric_values):
+    analyzers = [
+        Size(),
+        Mean("att1"),
+        ApproxCountDistinct("att1"),
+        DataType("att1"),
+        Compliance("c", "att1 > 3"),
+        Sum("att2"),
+    ]
+    ctx = AnalysisRunner.do_analysis_run(df_with_numeric_values, analyzers)
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.scan_passes == 1
+
+
+def test_grouping_analyzers_share_one_frequency_pass(df_with_unique_columns):
+    analyzers = [
+        Uniqueness(("nonUnique",)),
+        UniqueValueRatio(("nonUnique",)),
+        CountDistinct(("nonUnique",)),
+    ]
+    ctx = AnalysisRunner.do_analysis_run(df_with_unique_columns, analyzers)
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.grouping_passes == 1
+    assert SCAN_STATS.scan_passes == 0
+
+
+def test_different_groupings_get_separate_passes(df_with_unique_columns):
+    analyzers = [
+        Uniqueness(("unique",)),
+        Uniqueness(("nonUnique",)),
+        Uniqueness(("unique", "nonUnique")),
+    ]
+    AnalysisRunner.do_analysis_run(df_with_unique_columns, analyzers)
+    assert SCAN_STATS.grouping_passes == 3
+
+
+def test_mixed_workload_pass_accounting(df_with_unique_columns):
+    analyzers = [
+        Size(),
+        Completeness("unique"),
+        Uniqueness(("nonUnique",)),
+        UniqueValueRatio(("nonUnique",)),
+    ]
+    AnalysisRunner.do_analysis_run(df_with_unique_columns, analyzers)
+    assert SCAN_STATS.scan_passes == 1
+    assert SCAN_STATS.grouping_passes == 1
+
+
+def test_precondition_failures_do_not_trigger_passes(df_with_numeric_values):
+    analyzers = [Completeness("missing_col"), Minimum("also_missing")]
+    ctx = AnalysisRunner.do_analysis_run(df_with_numeric_values, analyzers)
+    assert all(m.value.is_failure for m in ctx.all_metrics())
+    assert SCAN_STATS.scan_passes == 0

@@ -1,0 +1,141 @@
+"""End-to-end VerificationSuite tests (analogue of VerificationSuiteTest.scala)."""
+
+import pytest
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, ColumnarTable, VerificationSuite
+from deequ_tpu.constraints import ConstrainableDataTypes, ConstraintStatus
+from deequ_tpu.verification import VerificationResult
+
+
+@pytest.fixture
+def table():
+    return ColumnarTable.from_pydict(
+        {
+            "id": [1, 2, 3, 4, 5, 6],
+            "productName": ["a", "b", "c", "d", "e", "f"],
+            "priority": ["high", "low", "high", "low", "low", "high"],
+            "numViews": [12, 5, 0, 136, 45, 3],
+            "description": [
+                "Thingy A", None, "Thingy B", "Thingy C", None, "Thingy D",
+            ],
+        }
+    )
+
+
+def test_basic_example_passes(table):
+    """The README basic example (reference README.md)."""
+    check = (
+        Check(CheckLevel.ERROR, "unit testing my data")
+        .has_size(lambda n: n == 6)
+        .is_complete("id")
+        .is_unique("id")
+        .is_complete("productName")
+        .is_contained_in("priority", ["high", "low"])
+        .is_non_negative("numViews")
+        .has_completeness("description", lambda c: c >= 0.5)
+    )
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    assert result.status == CheckStatus.SUCCESS
+    for cr in result.check_results.values():
+        for c in cr.constraint_results:
+            assert c.status == ConstraintStatus.SUCCESS, c.message
+
+
+def test_failing_check_reports_error(table):
+    check = (
+        Check(CheckLevel.ERROR, "failing")
+        .has_size(lambda n: n == 100)
+        .is_complete("description")
+    )
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    assert result.status == CheckStatus.ERROR
+    statuses = [
+        c.status
+        for cr in result.check_results.values()
+        for c in cr.constraint_results
+    ]
+    assert statuses.count(ConstraintStatus.FAILURE) == 2
+
+
+def test_warning_level(table):
+    check = Check(CheckLevel.WARNING, "warn only").has_size(lambda n: n == 100)
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    assert result.status == CheckStatus.WARNING
+
+
+def test_status_aggregation_error_beats_warning(table):
+    warn = Check(CheckLevel.WARNING, "w").has_size(lambda n: n == 100)
+    err = Check(CheckLevel.ERROR, "e").has_size(lambda n: n == 100)
+    ok = Check(CheckLevel.ERROR, "ok").has_size(lambda n: n == 6)
+    result = (
+        VerificationSuite.on_data(table)
+        .add_check(warn).add_check(err).add_check(ok)
+        .run()
+    )
+    assert result.status == CheckStatus.ERROR
+    assert result.check_results[ok].status == CheckStatus.SUCCESS
+    assert result.check_results[warn].status == CheckStatus.WARNING
+
+
+def test_where_filter_on_constraint(table):
+    # 'high' rows have numViews 12, 0, 3 -> max is 12
+    check = (
+        Check(CheckLevel.ERROR, "filtered")
+        .has_max("numViews", lambda v: v == 12).where("priority = 'high'")
+    )
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    assert result.status == CheckStatus.SUCCESS
+
+
+def test_data_type_check(table):
+    check = Check(CheckLevel.ERROR, "types").has_data_type(
+        "id", ConstrainableDataTypes.INTEGRAL
+    )
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    assert result.status == CheckStatus.SUCCESS
+
+
+def test_comparison_checks(table):
+    t = ColumnarTable.from_pydict({"a": [1.0, 2.0, 3.0], "b": [2.0, 3.0, 4.0]})
+    check = (
+        Check(CheckLevel.ERROR, "cmp")
+        .is_less_than("a", "b")
+        .is_less_than_or_equal_to("a", "b")
+        .is_greater_than("b", "a")
+        .is_greater_than_or_equal_to("b", "a")
+    )
+    result = VerificationSuite.on_data(t).add_check(check).run()
+    assert result.status == CheckStatus.SUCCESS
+
+
+def test_output_rows(table):
+    check = Check(CheckLevel.ERROR, "out").has_size(lambda n: n == 6)
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    rows = VerificationResult.success_metrics_as_rows(result)
+    assert {"entity": "Dataset", "instance": "*", "name": "Size", "value": 6.0} in rows
+    check_rows = VerificationResult.check_results_as_rows(result)
+    assert check_rows[0]["check_status"] == "Success"
+
+
+def test_required_analyzers_computed(table):
+    from deequ_tpu.analyzers import Entropy
+
+    result = (
+        VerificationSuite.on_data(table)
+        .add_required_analyzer(Entropy("priority"))
+        .run()
+    )
+    assert any(a == Entropy("priority") for a in result.metrics)
+
+
+def test_multiple_checks_share_one_scan(table):
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    c1 = Check(CheckLevel.ERROR, "c1").has_size(lambda n: n == 6).has_mean(
+        "numViews", lambda v: v > 0
+    )
+    c2 = Check(CheckLevel.ERROR, "c2").is_complete("id").has_max(
+        "numViews", lambda v: v == 136
+    )
+    VerificationSuite.on_data(table).add_check(c1).add_check(c2).run()
+    assert SCAN_STATS.scan_passes == 1
