@@ -279,9 +279,29 @@ def run_scan(
                 for op, acc, p in zip(ops, merged, partials)
             ]
 
-    # pipelined dispatch: keep a small window of chunks in flight so host
-    # packing, host->device transfer, and device compute overlap instead of
-    # serializing (jax dispatch is async; only the fetch blocks)
+    # pipelined dispatch: transfers go through explicit async device_put
+    # (one bulk transfer per buffer — the jit arg-conversion path can
+    # fragment them) and a small window of chunks stays in flight so host
+    # packing, host->device transfer, and device compute overlap. In the
+    # mesh path device_put gets the shardings matching in_specs so buffers
+    # land host->each-device directly, with no redistribution hop.
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        arg_shardings = (
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(ROW_AXIS)),
+        )
+
+        def put(args):
+            return tuple(
+                jax.device_put(a, s) for a, s in zip(args, arg_shardings)
+            )
+    else:
+        put = jax.device_put
+
     in_flight = []
     window = 3
     for ci in range(n_chunks):
@@ -290,7 +310,7 @@ def run_scan(
         args = packer.pack(start, stop)
         if shapes is None:
             shapes = jax.eval_shape(shape_fn, *args)
-        in_flight.append(step_fn(*args))
+        in_flight.append(step_fn(*put(args)))
         if len(in_flight) >= window:
             drain(in_flight.pop(0))
     for device_result in in_flight:

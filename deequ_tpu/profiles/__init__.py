@@ -1,0 +1,17 @@
+from deequ_tpu.profiles.profiler import (
+    ColumnProfile,
+    ColumnProfiler,
+    ColumnProfilerRunner,
+    ColumnProfiles,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+
+__all__ = [
+    "ColumnProfile",
+    "ColumnProfiler",
+    "ColumnProfilerRunner",
+    "ColumnProfiles",
+    "NumericColumnProfile",
+    "StandardColumnProfile",
+]

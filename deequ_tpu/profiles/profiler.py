@@ -1,0 +1,389 @@
+"""Column profiler (reference layer L11, profiles/ColumnProfiler.scala).
+
+Three passes over the data, designed for very large datasets (the reference
+doc comment at ColumnProfiler.scala:57-68):
+
+1. generic statistics — Size, per-column Completeness + ApproxCountDistinct
+  (+ DataType inference for string columns) — ONE fused scan;
+2. numeric statistics — Minimum/Maximum/Mean/StandardDeviation/Sum (and
+   optionally a KLL sketch) for numeric columns, including string columns
+   whose inferred type is numeric (cast first) — one fused scan + KLL pass;
+3. exact histograms for low-cardinality columns (approx distinct <=
+   ``low_cardinality_histogram_threshold``, default 120).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    DataType,
+    Histogram,
+    KLLParameters,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.analyzers.scan import DataTypeInstances, determine_type
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.metrics import BucketDistribution, Distribution
+
+DEFAULT_CARDINALITY_THRESHOLD = 120
+
+
+@dataclass
+class ColumnProfile:
+    column: str
+    completeness: float
+    approximate_num_distinct_values: int
+    data_type: DataTypeInstances
+    is_data_type_inferred: bool
+    type_counts: Dict[str, int] = field(default_factory=dict)
+    histogram: Optional[Distribution] = None
+
+
+@dataclass
+class StandardColumnProfile(ColumnProfile):
+    pass
+
+
+@dataclass
+class NumericColumnProfile(ColumnProfile):
+    kll: Optional[BucketDistribution] = None
+    mean: Optional[float] = None
+    maximum: Optional[float] = None
+    minimum: Optional[float] = None
+    sum: Optional[float] = None
+    std_dev: Optional[float] = None
+    approx_percentiles: Optional[List[float]] = None
+
+
+@dataclass
+class ColumnProfiles:
+    profiles: Dict[str, ColumnProfile]
+    num_records: int
+
+    def to_json(self) -> str:
+        columns = []
+        for profile in self.profiles.values():
+            entry = {
+                "column": profile.column,
+                "dataType": profile.data_type.value,
+                "isDataTypeInferred": str(profile.is_data_type_inferred).lower(),
+                "completeness": profile.completeness,
+                "approximateNumDistinctValues": profile.approximate_num_distinct_values,
+            }
+            if profile.type_counts:
+                entry["typeCounts"] = dict(profile.type_counts)
+            if profile.histogram is not None:
+                entry["histogram"] = [
+                    {"value": k, "count": v.absolute, "ratio": v.ratio}
+                    for k, v in profile.histogram.values.items()
+                ]
+            if isinstance(profile, NumericColumnProfile):
+                for key, value in (
+                    ("mean", profile.mean),
+                    ("maximum", profile.maximum),
+                    ("minimum", profile.minimum),
+                    ("sum", profile.sum),
+                    ("stdDev", profile.std_dev),
+                ):
+                    if value is not None:
+                        entry[key] = value
+                if profile.approx_percentiles:
+                    entry["approxPercentiles"] = profile.approx_percentiles
+            columns.append(entry)
+        return json.dumps({"columns": columns})
+
+
+def _cast_string_column_to_numeric(
+    col: Column, target: DataTypeInstances
+) -> Column:
+    """Cast a string column whose inferred type is numeric — unparsable
+    values become null (the analogue of ColumnProfiler.castColumn)."""
+    card = max(len(col.dictionary), 1)
+    lut = np.zeros(card, dtype=np.float64)
+    ok = np.zeros(card, dtype=np.bool_)
+    for i, v in enumerate(col.dictionary):
+        try:
+            lut[i] = float(v)
+            ok[i] = True
+        except (TypeError, ValueError):
+            pass
+    safe = np.maximum(col.codes, 0)
+    values = lut[safe]
+    mask = (col.codes >= 0) & ok[safe]
+    if target == DataTypeInstances.INTEGRAL:
+        return Column(col.name, DType.INTEGRAL,
+                      values=values.astype(np.int64), mask=mask)
+    return Column(col.name, DType.FRACTIONAL, values=values, mask=mask)
+
+
+_NATIVE_TYPES = {
+    DType.FRACTIONAL: DataTypeInstances.FRACTIONAL,
+    DType.INTEGRAL: DataTypeInstances.INTEGRAL,
+    DType.BOOLEAN: DataTypeInstances.BOOLEAN,
+}
+
+
+class ColumnProfiler:
+    @staticmethod
+    def profile(
+        data: ColumnarTable,
+        restrict_to_columns: Optional[Sequence[str]] = None,
+        print_status_updates: bool = False,
+        low_cardinality_histogram_threshold: int = DEFAULT_CARDINALITY_THRESHOLD,
+        metrics_repository=None,
+        reuse_existing_results_using_key=None,
+        fail_if_results_for_reusing_missing: bool = False,
+        save_in_metrics_repository_using_key=None,
+        kll_profiling: bool = False,
+        kll_parameters: Optional[KLLParameters] = None,
+        predefined_types: Optional[Dict[str, DataTypeInstances]] = None,
+    ) -> ColumnProfiles:
+        predefined_types = predefined_types or {}
+        if restrict_to_columns is not None:
+            for name in restrict_to_columns:
+                if name not in data:
+                    raise ValueError(f"Unable to find column {name}")
+            relevant = [c for c in data.column_names if c in set(restrict_to_columns)]
+        else:
+            relevant = data.column_names
+
+        run_kwargs = dict(
+            metrics_repository=metrics_repository,
+            reuse_existing_results_for_key=reuse_existing_results_using_key,
+            fail_if_results_missing=fail_if_results_for_reusing_missing,
+            save_or_append_results_with_key=save_in_metrics_repository_using_key,
+        )
+
+        # -- pass 1: generic statistics (ColumnProfiler.scala:122-139) ------
+        if print_status_updates:
+            print("### PROFILING: Computing generic column statistics in pass (1/3)...")
+        analyzers = [Size()]
+        for name in relevant:
+            analyzers.append(Completeness(name))
+            analyzers.append(ApproxCountDistinct(name))
+            if data[name].dtype == DType.STRING and name not in predefined_types:
+                analyzers.append(DataType(name))
+        ctx1 = AnalysisRunner.do_analysis_run(data, analyzers, **run_kwargs)
+
+        num_records = int(ctx1.metric_map[Size()].value.get_or_else(0.0))
+
+        completeness: Dict[str, float] = {}
+        approx_distinct: Dict[str, int] = {}
+        inferred_type: Dict[str, DataTypeInstances] = {}
+        is_inferred: Dict[str, bool] = {}
+        type_counts: Dict[str, Dict[str, int]] = {}
+        for name in relevant:
+            completeness[name] = ctx1.metric_map[Completeness(name)].value.get_or_else(
+                float("nan")
+            )
+            approx_distinct[name] = int(
+                round(
+                    ctx1.metric_map[ApproxCountDistinct(name)].value.get_or_else(0.0)
+                )
+            )
+            col_dtype = data[name].dtype
+            if name in predefined_types:
+                inferred_type[name] = predefined_types[name]
+                is_inferred[name] = False
+                type_counts[name] = {}
+            elif col_dtype == DType.STRING:
+                metric = ctx1.metric_map[DataType(name)]
+                if metric.value.is_success:
+                    dist = metric.value.get()
+                    inferred_type[name] = determine_type(dist)
+                    type_counts[name] = {
+                        k: v.absolute for k, v in dist.values.items()
+                    }
+                else:
+                    inferred_type[name] = DataTypeInstances.UNKNOWN
+                    type_counts[name] = {}
+                is_inferred[name] = True
+            else:
+                inferred_type[name] = _NATIVE_TYPES[col_dtype]
+                is_inferred[name] = False
+                type_counts[name] = {}
+
+        # cast string columns that are inferred numeric (scala L153-154)
+        casted = data
+        for name in relevant:
+            if data[name].dtype == DType.STRING and inferred_type[name] in (
+                DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL,
+            ):
+                casted = casted.with_column(
+                    _cast_string_column_to_numeric(data[name], inferred_type[name])
+                )
+
+        numeric_columns = [
+            name
+            for name in relevant
+            if inferred_type[name]
+            in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL)
+        ]
+
+        # -- pass 2: numeric statistics (scala L157-173) --------------------
+        if print_status_updates:
+            print("### PROFILING: Computing numeric column statistics in pass (2/3)...")
+        numeric_analyzers = []
+        for name in numeric_columns:
+            numeric_analyzers += [
+                Minimum(name), Maximum(name), Mean(name),
+                StandardDeviation(name), Sum(name),
+            ]
+            if kll_profiling:
+                numeric_analyzers.append(KLLSketch(name, kll_parameters))
+        ctx2 = (
+            AnalysisRunner.do_analysis_run(casted, numeric_analyzers, **run_kwargs)
+            if numeric_analyzers
+            else None
+        )
+
+        # -- pass 3: exact histograms for low-cardinality columns -----------
+        if print_status_updates:
+            print("### PROFILING: Computing histograms of low-cardinality columns in pass (3/3)...")
+        histograms: Dict[str, Distribution] = {}
+        histogram_targets = [
+            name
+            for name in relevant
+            if approx_distinct[name] <= low_cardinality_histogram_threshold
+            and inferred_type[name]
+            in (
+                DataTypeInstances.STRING,
+                DataTypeInstances.BOOLEAN,
+                DataTypeInstances.INTEGRAL,
+            )
+        ]
+        for name in histogram_targets:
+            metric = Histogram(name).calculate(data)
+            if metric.value.is_success:
+                histograms[name] = metric.value.get()
+
+        # -- assemble -------------------------------------------------------
+        profiles: Dict[str, ColumnProfile] = {}
+        for name in relevant:
+            base = dict(
+                column=name,
+                completeness=completeness[name],
+                approximate_num_distinct_values=approx_distinct[name],
+                data_type=inferred_type[name],
+                is_data_type_inferred=is_inferred[name],
+                type_counts=type_counts[name],
+                histogram=histograms.get(name),
+            )
+            if name in numeric_columns and ctx2 is not None:
+                def metric_value(analyzer):
+                    m = ctx2.metric_map.get(analyzer)
+                    if m is not None and m.value.is_success:
+                        return float(m.value.get())
+                    return None
+
+                kll_dist = None
+                approx_percentiles = None
+                if kll_profiling:
+                    kll_metric = ctx2.metric_map.get(KLLSketch(name, kll_parameters))
+                    if kll_metric is not None and kll_metric.value.is_success:
+                        kll_dist = kll_metric.value.get()
+                        approx_percentiles = kll_dist.compute_percentiles()
+                profiles[name] = NumericColumnProfile(
+                    **base,
+                    kll=kll_dist,
+                    mean=metric_value(Mean(name)),
+                    maximum=metric_value(Maximum(name)),
+                    minimum=metric_value(Minimum(name)),
+                    sum=metric_value(Sum(name)),
+                    std_dev=metric_value(StandardDeviation(name)),
+                    approx_percentiles=approx_percentiles,
+                )
+            else:
+                profiles[name] = StandardColumnProfile(**base)
+
+        return ColumnProfiles(profiles, num_records)
+
+
+class ColumnProfilerRunner:
+    """Fluent wrapper (reference profiles/ColumnProfilerRunner.scala:37-113,
+    ColumnProfilerRunBuilder.scala:25-245)."""
+
+    @staticmethod
+    def on_data(data: ColumnarTable) -> "ColumnProfilerRunBuilder":
+        return ColumnProfilerRunBuilder(data)
+
+
+class ColumnProfilerRunBuilder:
+    def __init__(self, data: ColumnarTable):
+        self._data = data
+        self._restrict_to_columns: Optional[Sequence[str]] = None
+        self._print_status_updates = False
+        self._threshold = DEFAULT_CARDINALITY_THRESHOLD
+        self._metrics_repository = None
+        self._reuse_key = None
+        self._fail_if_missing = False
+        self._save_key = None
+        self._kll_profiling = False
+        self._kll_parameters: Optional[KLLParameters] = None
+        self._predefined_types: Dict[str, DataTypeInstances] = {}
+
+    def restrict_to_columns(self, columns: Sequence[str]):
+        self._restrict_to_columns = columns
+        return self
+
+    def print_status_updates(self, value: bool):
+        self._print_status_updates = value
+        return self
+
+    def with_low_cardinality_histogram_threshold(self, threshold: int):
+        self._threshold = threshold
+        return self
+
+    def with_kll_profiling(self):
+        self._kll_profiling = True
+        return self
+
+    def set_kll_parameters(self, parameters: KLLParameters):
+        self._kll_parameters = parameters
+        return self
+
+    def set_predefined_types(self, types: Dict[str, DataTypeInstances]):
+        self._predefined_types = dict(types)
+        return self
+
+    def use_repository(self, repository):
+        self._metrics_repository = repository
+        return self
+
+    def reuse_existing_results_for_key(self, key, fail_if_missing: bool = False):
+        self._reuse_key = key
+        self._fail_if_missing = fail_if_missing
+        return self
+
+    def save_or_append_result(self, key):
+        self._save_key = key
+        return self
+
+    def run(self) -> ColumnProfiles:
+        return ColumnProfiler.profile(
+            self._data,
+            restrict_to_columns=self._restrict_to_columns,
+            print_status_updates=self._print_status_updates,
+            low_cardinality_histogram_threshold=self._threshold,
+            metrics_repository=self._metrics_repository,
+            reuse_existing_results_using_key=self._reuse_key,
+            fail_if_results_for_reusing_missing=self._fail_if_missing,
+            save_in_metrics_repository_using_key=self._save_key,
+            kll_profiling=self._kll_profiling,
+            kll_parameters=self._kll_parameters,
+            predefined_types=self._predefined_types,
+        )

@@ -1,0 +1,11 @@
+from deequ_tpu.schema.validator import (
+    RowLevelSchema,
+    RowLevelSchemaValidationResult,
+    RowLevelSchemaValidator,
+)
+
+__all__ = [
+    "RowLevelSchema",
+    "RowLevelSchemaValidationResult",
+    "RowLevelSchemaValidator",
+]

@@ -1,0 +1,141 @@
+"""Profiler + constraint-suggestion tests (analogues of
+ColumnProfilerRunnerTest and ConstraintSuggestionsIntegrationTest)."""
+
+import json
+
+import pytest
+
+from deequ_tpu.analyzers.scan import DataTypeInstances
+from deequ_tpu.data.table import ColumnarTable
+from deequ_tpu.profiles import (
+    ColumnProfilerRunner,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.suggestions import (
+    ConstraintSuggestionRunner,
+    Rules,
+    UniqueIfApproximatelyUniqueRule,
+)
+
+
+@pytest.fixture
+def table():
+    n = 200
+    return ColumnarTable.from_pydict(
+        {
+            "id": list(range(n)),                     # unique ints
+            "name": [f"name_{i}" for i in range(n)],  # unique strings
+            "status": ["active", "inactive"] * (n // 2),
+            "score": [float(i % 50) for i in range(n)],
+            "maybe": [None if i % 4 == 0 else f"{i % 3}" for i in range(n)],
+        }
+    )
+
+
+def test_profiler_basic(table):
+    profiles = ColumnProfilerRunner.on_data(table).run()
+    assert profiles.num_records == 200
+
+    id_profile = profiles.profiles["id"]
+    assert isinstance(id_profile, NumericColumnProfile)
+    assert id_profile.completeness == 1.0
+    assert id_profile.data_type == DataTypeInstances.INTEGRAL
+    assert not id_profile.is_data_type_inferred
+    assert id_profile.minimum == 0.0
+    assert id_profile.maximum == 199.0
+    assert abs(id_profile.mean - 99.5) < 1e-9
+    assert abs(id_profile.approximate_num_distinct_values - 200) < 30
+
+    status = profiles.profiles["status"]
+    assert isinstance(status, StandardColumnProfile)
+    assert status.data_type == DataTypeInstances.STRING
+    assert status.histogram is not None  # low cardinality -> exact histogram
+    assert status.histogram["active"].absolute == 100
+
+    # 'maybe' is a string column holding small ints with nulls -> inferred
+    # Integral, cast for numeric statistics
+    maybe = profiles.profiles["maybe"]
+    assert maybe.data_type == DataTypeInstances.INTEGRAL
+    assert maybe.is_data_type_inferred
+    assert isinstance(maybe, NumericColumnProfile)
+    assert maybe.completeness == 0.75
+    assert maybe.minimum == 0.0
+    assert maybe.maximum == 2.0
+
+
+def test_profiler_restrict_columns(table):
+    profiles = (
+        ColumnProfilerRunner.on_data(table).restrict_to_columns(["id", "status"]).run()
+    )
+    assert set(profiles.profiles) == {"id", "status"}
+
+
+def test_profiler_histogram_threshold(table):
+    profiles = (
+        ColumnProfilerRunner.on_data(table)
+        .with_low_cardinality_histogram_threshold(1)
+        .run()
+    )
+    assert profiles.profiles["status"].histogram is None
+
+
+def test_profiler_kll(table):
+    profiles = ColumnProfilerRunner.on_data(table).with_kll_profiling().run()
+    score = profiles.profiles["score"]
+    assert score.kll is not None
+    assert len(score.approx_percentiles) == 100
+
+
+def test_profiler_json(table):
+    profiles = ColumnProfilerRunner.on_data(table).run()
+    data = json.loads(profiles.to_json())
+    assert len(data["columns"]) == 5
+
+
+def test_suggestions_default_rules(table):
+    result = (
+        ConstraintSuggestionRunner.on_data(table)
+        .add_constraint_rules(Rules.DEFAULT)
+        .run()
+    )
+    by_col = result.suggestions
+    codes = [s.code_for_constraint for s in result.all_suggestions]
+    # complete columns suggest is_complete
+    assert '.is_complete("id")' in codes
+    assert '.is_complete("status")' in codes
+    # categorical range for status
+    assert any("is_contained_in" in c and "status" in c for c in codes)
+    # non-negative numbers
+    assert '.is_non_negative("id")' in codes
+    # incomplete 'maybe' suggests completeness retention
+    assert any("has_completeness" in c and "maybe" in c for c in codes)
+    # type retention for inferred integral string column
+    assert any("has_data_type" in c and "maybe" in c for c in codes)
+
+
+def test_suggestions_unique_rule(table):
+    result = (
+        ConstraintSuggestionRunner.on_data(table)
+        .add_constraint_rule(UniqueIfApproximatelyUniqueRule())
+        .run()
+    )
+    codes = [s.code_for_constraint for s in result.all_suggestions]
+    assert '.is_unique("id")' in codes
+    assert '.is_unique("name")' in codes
+    assert not any("status" in c for c in codes)
+
+
+def test_suggestions_with_train_test_evaluation(table):
+    result = (
+        ConstraintSuggestionRunner.on_data(table)
+        .add_constraint_rules(Rules.DEFAULT)
+        .use_train_test_split_with_test_set_ratio(0.3, seed=7)
+        .run()
+    )
+    assert result.verification_result is not None
+    evaluation = json.loads(result.evaluation_as_json())
+    assert len(evaluation["constraint_suggestions"]) == len(result.all_suggestions)
+    # suggestions JSON exporter works
+    sugg = json.loads(result.suggestions_as_json())
+    assert len(sugg["constraint_suggestions"]) == len(result.all_suggestions)

@@ -1,0 +1,110 @@
+"""Row-level schema validator + applicability tests (analogues of
+RowLevelSchemaValidatorTest and checks/ApplicabilityTest.scala)."""
+
+import pytest
+
+from deequ_tpu import Check, CheckLevel, ColumnarTable, VerificationSuite
+from deequ_tpu.data.table import DType, Field, Schema
+from deequ_tpu.schema import RowLevelSchema, RowLevelSchemaValidator
+
+
+@pytest.fixture
+def raw_table():
+    return ColumnarTable.from_pydict(
+        {
+            "id": ["1", "2", "three", "4", None],
+            "name": ["ab", "x", "cdef", "ghij", "kl"],
+            "dec": ["1.23", "4.5", "6.789", "bad", "0.1"],
+            "ts": ["2024-01-01", "2024-02-30", "2024-03-03", "nope", "2024-05-05"],
+        }
+    )
+
+
+def test_int_column_validation(raw_table):
+    schema = RowLevelSchema().with_int_column("id", is_nullable=False)
+    result = RowLevelSchemaValidator.validate(raw_table, schema)
+    # "three" fails the cast, None fails non-nullable
+    assert result.num_valid_rows == 3
+    assert result.num_invalid_rows == 2
+    assert result.valid_rows["id"].dtype == DType.INTEGRAL
+    assert result.valid_rows["id"].to_pylist() == [1, 2, 4]
+
+
+def test_int_bounds(raw_table):
+    schema = RowLevelSchema().with_int_column("id", min_value=2, max_value=10)
+    result = RowLevelSchemaValidator.validate(raw_table, schema)
+    # valid: "2", "4", and null (nullable, passes bounds via CNF null-or)
+    assert result.num_valid_rows == 3
+
+
+def test_string_length_and_regex(raw_table):
+    schema = RowLevelSchema().with_string_column(
+        "name", min_length=2, max_length=4
+    )
+    result = RowLevelSchemaValidator.validate(raw_table, schema)
+    assert result.num_valid_rows == 4  # "x" too short
+
+    schema2 = RowLevelSchema().with_string_column("name", matches="^[a-f]+$")
+    result2 = RowLevelSchemaValidator.validate(raw_table, schema2)
+    assert result2.num_valid_rows == 2  # ab, cdef
+
+
+def test_decimal_column(raw_table):
+    schema = RowLevelSchema().with_decimal_column("dec", precision=4, scale=3)
+    result = RowLevelSchemaValidator.validate(raw_table, schema)
+    # "bad" unparsable; others have <= 1 integral digit
+    assert result.num_valid_rows == 4
+    assert result.valid_rows["dec"].dtype == DType.FRACTIONAL
+
+
+def test_timestamp_column(raw_table):
+    schema = RowLevelSchema().with_timestamp_column("ts", mask="yyyy-MM-dd")
+    result = RowLevelSchemaValidator.validate(raw_table, schema)
+    # "2024-02-30" invalid date, "nope" unparsable
+    assert result.num_valid_rows == 3
+    assert result.valid_rows["ts"].dtype == DType.INTEGRAL  # epoch millis
+
+
+def test_combined_schema_quarantine(raw_table):
+    schema = (
+        RowLevelSchema()
+        .with_int_column("id", is_nullable=False)
+        .with_string_column("name", min_length=2)
+    )
+    result = RowLevelSchemaValidator.validate(raw_table, schema)
+    assert result.num_valid_rows + result.num_invalid_rows == raw_table.num_rows
+    # invalid rows keep original string data for quarantine inspection
+    assert result.invalid_rows["id"].dtype == DType.STRING
+
+
+def test_check_applicability():
+    schema = Schema(
+        [
+            Field("item", DType.STRING),
+            Field("count", DType.INTEGRAL),
+        ]
+    )
+    good = (
+        Check(CheckLevel.ERROR, "ok")
+        .is_complete("item")
+        .has_min("count", lambda v: v > 0)
+    )
+    result = VerificationSuite.is_check_applicable_to_data(good, schema)
+    assert result.is_applicable
+
+    bad = Check(CheckLevel.ERROR, "bad").has_min("item", lambda v: v > 0)
+    result2 = VerificationSuite.is_check_applicable_to_data(bad, schema)
+    assert not result2.is_applicable
+    assert len(result2.failures) == 1
+
+
+def test_analyzers_applicability():
+    from deequ_tpu.analyzers import Completeness, Mean
+
+    schema = Schema([Field("x", DType.FRACTIONAL), Field("s", DType.STRING)])
+    ok = VerificationSuite.are_analyzers_applicable_to_data(
+        [Completeness("x"), Mean("x")], schema
+    )
+    assert ok.is_applicable
+    bad = VerificationSuite.are_analyzers_applicable_to_data([Mean("s")], schema)
+    assert not bad.is_applicable
