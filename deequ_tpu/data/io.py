@@ -1,0 +1,174 @@
+"""Data ingestion: CSV / Parquet / pandas -> ColumnarTable.
+
+The reference delegates IO to Spark; here ingestion produces the columnar,
+dictionary-encoded representation the device engine consumes. CSV uses the
+stdlib reader with type inference (empty fields are nulls); Parquet and
+pandas interop go through pyarrow/pandas when available (both are present
+in this image) and degrade with a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deequ_tpu.data.table import Column, ColumnarTable, DType, _string_column
+
+_TRUE = {"true", "True", "TRUE"}
+_FALSE = {"false", "False", "FALSE"}
+
+
+def _infer_cell(cell: str):
+    if cell == "":
+        return None
+    return cell
+
+
+def read_csv(
+    path: str,
+    delimiter: str = ",",
+    header: bool = True,
+    column_names: Optional[Sequence[str]] = None,
+    infer_types: bool = True,
+) -> ColumnarTable:
+    """Read a CSV file into a ColumnarTable with per-column type inference
+    (integral -> fractional -> boolean -> string; empty cells are null)."""
+    with open(path, newline="") as f:
+        reader = csv.reader(f, delimiter=delimiter)
+        rows = list(reader)
+    if not rows:
+        return ColumnarTable([])
+    if header:
+        names = rows[0]
+        rows = rows[1:]
+    else:
+        names = column_names or [f"_c{i}" for i in range(len(rows[0]))]
+    columns: Dict[str, list] = {name: [] for name in names}
+    for row in rows:
+        for i, name in enumerate(names):
+            cell = row[i] if i < len(row) else ""
+            columns[name].append(_infer_cell(cell))
+    out = []
+    for name, raw in columns.items():
+        out.append(_build_typed_column(name, raw, infer_types))
+    return ColumnarTable(out)
+
+
+def _build_typed_column(name: str, raw: List[Optional[str]], infer: bool) -> Column:
+    non_null = [v for v in raw if v is not None]
+    if infer and non_null:
+        if all(_is_int(v) for v in non_null):
+            values = np.array(
+                [int(v) if v is not None else 0 for v in raw], dtype=np.int64
+            )
+            mask = np.array([v is not None for v in raw])
+            return Column(name, DType.INTEGRAL, values=values, mask=mask)
+        if all(_is_float(v) for v in non_null):
+            values = np.array(
+                [float(v) if v is not None else 0.0 for v in raw], dtype=np.float64
+            )
+            mask = np.array([v is not None for v in raw])
+            return Column(name, DType.FRACTIONAL, values=values, mask=mask)
+        if all(v in _TRUE or v in _FALSE for v in non_null):
+            values = np.array(
+                [v in _TRUE if v is not None else False for v in raw]
+            )
+            mask = np.array([v is not None for v in raw])
+            return Column(name, DType.BOOLEAN, values=values, mask=mask)
+    return _string_column(name, raw)
+
+
+def _is_int(v: str) -> bool:
+    try:
+        int(v)
+        return True
+    except ValueError:
+        return False
+
+
+def _is_float(v: str) -> bool:
+    try:
+        float(v)
+        return True
+    except ValueError:
+        return False
+
+
+def read_parquet(path: str, columns: Optional[Sequence[str]] = None) -> ColumnarTable:
+    """Read a Parquet file via pyarrow."""
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "read_parquet requires pyarrow, which is not installed"
+        ) from e
+    table = pq.read_table(path, columns=list(columns) if columns else None)
+    return from_arrow(table)
+
+
+def from_arrow(table) -> ColumnarTable:
+    """Convert a pyarrow Table."""
+    import pyarrow as pa
+
+    cols = []
+    for name, column in zip(table.column_names, table.columns):
+        combined = column.combine_chunks()
+        pa_type = combined.type
+        if pa.types.is_integer(pa_type):
+            mask = ~np.asarray(combined.is_null())
+            values = np.asarray(combined.fill_null(0), dtype=np.int64)
+            cols.append(Column(name, DType.INTEGRAL, values=values, mask=mask))
+        elif pa.types.is_floating(pa_type):
+            mask = ~np.asarray(combined.is_null())
+            values = np.nan_to_num(
+                np.asarray(combined.fill_null(0.0), dtype=np.float64)
+            ) * mask
+            cols.append(Column(name, DType.FRACTIONAL, values=values, mask=mask))
+        elif pa.types.is_boolean(pa_type):
+            mask = ~np.asarray(combined.is_null())
+            values = np.asarray(combined.fill_null(False), dtype=np.bool_)
+            cols.append(Column(name, DType.BOOLEAN, values=values, mask=mask))
+        else:
+            strings = [None if v is None else str(v) for v in combined.to_pylist()]
+            cols.append(_string_column(name, strings))
+    return ColumnarTable(cols)
+
+
+def from_pandas(df) -> ColumnarTable:
+    """Convert a pandas DataFrame."""
+    import pandas as pd
+
+    cols = []
+    for name in df.columns:
+        series = df[name]
+        if pd.api.types.is_integer_dtype(series.dtype):
+            cols.append(
+                Column(
+                    str(name), DType.INTEGRAL,
+                    values=series.to_numpy(dtype=np.int64),
+                    mask=np.ones(len(series), dtype=np.bool_),
+                )
+            )
+        elif pd.api.types.is_float_dtype(series.dtype):
+            arr = series.to_numpy(dtype=np.float64)
+            mask = ~np.isnan(arr)
+            cols.append(
+                Column(
+                    str(name), DType.FRACTIONAL,
+                    values=np.nan_to_num(arr), mask=mask,
+                )
+            )
+        elif pd.api.types.is_bool_dtype(series.dtype):
+            cols.append(
+                Column(
+                    str(name), DType.BOOLEAN,
+                    values=series.to_numpy(dtype=np.bool_),
+                    mask=np.ones(len(series), dtype=np.bool_),
+                )
+            )
+        else:
+            strings = [None if pd.isna(v) else str(v) for v in series]
+            cols.append(_string_column(str(name), strings))
+    return ColumnarTable(cols)

@@ -1,0 +1,40 @@
+"""Anomaly detection example (analogue of examples/AnomalyDetectionExample
+.scala): alert when today's row count grows anomalously vs history."""
+
+from deequ_tpu import CheckStatus, ColumnarTable, VerificationSuite
+from deequ_tpu.analyzers import Size
+from deequ_tpu.anomaly import RelativeRateOfChangeStrategy
+from deequ_tpu.repository import InMemoryMetricsRepository, ResultKey
+
+
+def run():
+    repository = InMemoryMetricsRepository()
+
+    yesterday = ColumnarTable.from_pydict({"v": [1.0] * 100})
+    (
+        VerificationSuite.on_data(yesterday)
+        .use_repository(repository)
+        .save_or_append_result(ResultKey(1))
+        .add_required_analyzer(Size())
+        .run()
+    )
+
+    # today the dataset suddenly has 5x the rows
+    today = ColumnarTable.from_pydict({"v": [1.0] * 500})
+    result = (
+        VerificationSuite.on_data(today)
+        .use_repository(repository)
+        .save_or_append_result(ResultKey(2))
+        .add_anomaly_check(
+            RelativeRateOfChangeStrategy(max_rate_increase=2.0), Size()
+        )
+        .run()
+    )
+
+    if result.status != CheckStatus.SUCCESS:
+        print("Anomaly detected in the Size() metric!")
+    return result
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,43 @@
+"""Basic verification example (the analogue of the reference's
+examples/BasicExample.scala / README walkthrough)."""
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, ColumnarTable, VerificationSuite
+from deequ_tpu.verification import VerificationResult
+
+
+def run():
+    data = ColumnarTable.from_pydict(
+        {
+            "id": [1, 2, 3, 4, 5],
+            "productName": ["thingA", "thingB", None, "thingD", "thingE"],
+            "priority": ["high", "low", "high", "low", "high"],
+            "numViews": [0, 5, 10, 3, 12],
+        }
+    )
+
+    verification_result = (
+        VerificationSuite.on_data(data)
+        .add_check(
+            Check(CheckLevel.ERROR, "integrity checks")
+            .has_size(lambda n: n == 5)
+            .is_complete("id")
+            .is_unique("id")
+            .is_complete("productName")
+            .is_contained_in("priority", ["high", "low"])
+            .is_non_negative("numViews")
+        )
+        .run()
+    )
+
+    if verification_result.status == CheckStatus.SUCCESS:
+        print("The data passed the test, everything is fine!")
+    else:
+        print("We found errors in the data:")
+        for row in VerificationResult.check_results_as_rows(verification_result):
+            if row["constraint_status"] != "Success":
+                print(f"  {row['constraint']}: {row['constraint_message']}")
+    return verification_result
+
+
+if __name__ == "__main__":
+    run()

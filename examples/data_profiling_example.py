@@ -1,0 +1,48 @@
+"""Data profiling + constraint suggestion example (analogues of
+examples/DataProfilingExample.scala and ConstraintSuggestionExample.scala),
+run on the titanic dataset when available."""
+
+import os
+
+from deequ_tpu.data.io import read_csv
+from deequ_tpu.data.table import ColumnarTable
+from deequ_tpu.profiles import ColumnProfilerRunner, NumericColumnProfile
+from deequ_tpu.suggestions import ConstraintSuggestionRunner, Rules
+
+TITANIC = "/root/reference/test-data/titanic.csv"
+
+
+def run():
+    if os.path.exists(TITANIC):
+        data = read_csv(TITANIC)
+    else:
+        data = ColumnarTable.from_pydict(
+            {"Age": [22.0, 38.0, None, 35.0], "Sex": ["m", "f", "f", "m"]}
+        )
+
+    profiles = ColumnProfilerRunner.on_data(data).run()
+    print(f"profiled {len(profiles.profiles)} columns over "
+          f"{profiles.num_records} records")
+    for name, profile in profiles.profiles.items():
+        line = (
+            f"  {name}: type={profile.data_type.value} "
+            f"completeness={profile.completeness:.3f} "
+            f"approxDistinct={profile.approximate_num_distinct_values}"
+        )
+        if isinstance(profile, NumericColumnProfile) and profile.mean is not None:
+            line += f" mean={profile.mean:.2f}"
+        print(line)
+
+    suggestions = (
+        ConstraintSuggestionRunner.on_data(data)
+        .add_constraint_rules(Rules.DEFAULT)
+        .run()
+    )
+    print("suggested constraints:")
+    for s in suggestions.all_suggestions:
+        print(f"  {s.code_for_constraint}")
+    return suggestions
+
+
+if __name__ == "__main__":
+    run()

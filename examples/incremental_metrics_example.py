@@ -1,0 +1,37 @@
+"""Incremental metrics via algebraic states (the analogue of
+examples/IncrementalMetricsExample.scala): yesterday's persisted states
+merge with today's delta — no rescan of old data."""
+
+from deequ_tpu import ColumnarTable
+from deequ_tpu.analyzers import Completeness, Mean, Size
+from deequ_tpu.analyzers.runner import AnalysisRunner, AnalyzerContext
+from deequ_tpu.states import InMemoryStateProvider
+
+
+def run():
+    day1 = ColumnarTable.from_pydict(
+        {"views": [10.0, 20.0, None, 40.0], "region": ["EU", "EU", "US", "US"]}
+    )
+    day2 = ColumnarTable.from_pydict(
+        {"views": [50.0, 60.0], "region": ["ASIA", "EU"]}
+    )
+
+    analyzers = [Size(), Mean("views"), Completeness("views")]
+
+    states = InMemoryStateProvider()
+    day1_metrics = AnalysisRunner.do_analysis_run(
+        day1, analyzers, save_states_with=states
+    )
+    print("day 1:", AnalyzerContext.success_metrics_as_rows(day1_metrics))
+
+    # compute metrics over day1 UNION day2 by scanning ONLY day2
+    combined = AnalysisRunner.do_analysis_run(
+        day2, analyzers, aggregate_with=states
+    )
+    print("day 1+2 (only day 2 scanned):",
+          AnalyzerContext.success_metrics_as_rows(combined))
+    return combined
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,46 @@
+"""KLL sketch example (analogues of examples/KLLExample.scala and
+KLLCheckExample.scala)."""
+
+import numpy as np
+
+from deequ_tpu import Check, CheckLevel, ColumnarTable, VerificationSuite
+from deequ_tpu.analyzers import KLLParameters, KLLSketch
+from deequ_tpu.analyzers.runner import AnalysisRunner
+
+
+def run():
+    rng = np.random.default_rng(0)
+    data = ColumnarTable.from_pydict(
+        {"latency_ms": rng.lognormal(3.0, 0.8, 50_000).tolist()}
+    )
+
+    analyzer = KLLSketch(
+        "latency_ms", KLLParameters(sketch_size=2048, shrinking_factor=0.64,
+                                    number_of_buckets=10)
+    )
+    ctx = AnalysisRunner.do_analysis_run(data, [analyzer])
+    dist = ctx.metric_map[analyzer].value.get()
+    print("bucketed latency distribution:")
+    for b in dist.buckets:
+        print(f"  [{b.low_value:9.2f}, {b.high_value:9.2f}): {b.count}")
+
+    percentiles = dist.compute_percentiles()
+    print(f"p50={percentiles[49]:.1f}ms p99={percentiles[98]:.1f}ms")
+
+    result = (
+        VerificationSuite.on_data(data)
+        .add_check(
+            Check(CheckLevel.ERROR, "latency SLO").kll_sketch_satisfies(
+                "latency_ms",
+                lambda d: d.compute_percentiles()[98] < 500.0,
+                hint="p99 must stay under 500ms",
+            )
+        )
+        .run()
+    )
+    print("SLO check:", result.status)
+    return result
+
+
+if __name__ == "__main__":
+    run()

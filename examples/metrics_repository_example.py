@@ -1,0 +1,44 @@
+"""Metrics repository example (analogue of examples/MetricsRepositoryExample
+.scala): store metrics as a queryable time series."""
+
+from deequ_tpu import Check, CheckLevel, ColumnarTable, VerificationSuite
+from deequ_tpu.analyzers import Completeness, Size
+from deequ_tpu.repository import InMemoryMetricsRepository, ResultKey
+
+
+def run():
+    repository = InMemoryMetricsRepository()
+
+    for day, rows in enumerate(
+        [
+            {"v": [1.0, 2.0, 3.0]},
+            {"v": [1.0, None, 3.0, 4.0]},
+            {"v": [1.0, 2.0, 3.0, 4.0, 5.0]},
+        ],
+        start=1,
+    ):
+        data = ColumnarTable.from_pydict(rows)
+        (
+            VerificationSuite.on_data(data)
+            .use_repository(repository)
+            .save_or_append_result(ResultKey(day, {"dataset": "demo"}))
+            .add_check(
+                Check(CheckLevel.ERROR, "quality").has_size(lambda n: n > 0)
+            )
+            .add_required_analyzer(Completeness("v"))
+            .run()
+        )
+
+    rows = (
+        repository.load()
+        .with_tag_values({"dataset": "demo"})
+        .for_analyzers([Size(), Completeness("v")])
+        .get_success_metrics_as_rows()
+    )
+    for row in rows:
+        print(row)
+    return rows
+
+
+if __name__ == "__main__":
+    run()

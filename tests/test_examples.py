@@ -1,0 +1,18 @@
+"""Execute every example (the analogue of examples/ExamplesTest.scala)."""
+
+import importlib
+import pathlib
+import sys
+
+import pytest
+
+EXAMPLES_DIR = pathlib.Path(__file__).resolve().parent.parent / "examples"
+sys.path.insert(0, str(EXAMPLES_DIR))
+
+EXAMPLES = sorted(p.stem for p in EXAMPLES_DIR.glob("*_example.py"))
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    module = importlib.import_module(name)
+    assert module.run() is not None

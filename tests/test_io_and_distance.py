@@ -1,0 +1,114 @@
+"""IO (CSV/Parquet/pandas) + Distance tests, including the titanic.csv
+integration check (the reference's only real dataset)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu import Check, CheckLevel, CheckStatus, VerificationSuite
+from deequ_tpu.data.io import from_pandas, read_csv, read_parquet
+from deequ_tpu.data.table import DType
+from deequ_tpu.analyzers.distance import categorical_distance, numerical_distance
+from deequ_tpu.ops.kll import KLLSketchState
+
+TITANIC = "/root/reference/test-data/titanic.csv"
+
+
+def test_read_titanic_csv():
+    table = read_csv(TITANIC)
+    assert table.num_rows == 891
+    assert table["PassengerId"].dtype == DType.INTEGRAL
+    assert table["Fare"].dtype == DType.FRACTIONAL
+    assert table["Name"].dtype == DType.STRING
+    assert table["Age"].dtype == DType.FRACTIONAL  # has empties -> nullable
+    assert table["Age"].num_valid == 714  # known titanic missing-age count
+
+
+def test_titanic_verification():
+    """BASELINE.md config #1: Size/Completeness/Uniqueness on titanic."""
+    table = read_csv(TITANIC)
+    check = (
+        Check(CheckLevel.ERROR, "titanic")
+        .has_size(lambda n: n == 891)
+        .is_complete("PassengerId")
+        .is_unique("PassengerId")
+        .has_completeness("Age", lambda c: abs(c - 714 / 891) < 1e-9)
+        .is_contained_in("Sex", ["male", "female"])
+        .is_contained_in("Embarked", ["S", "C", "Q"])
+        .is_non_negative("Fare")
+    )
+    result = VerificationSuite.on_data(table).add_check(check).run()
+    assert result.status == CheckStatus.SUCCESS
+
+
+def test_titanic_profile():
+    from deequ_tpu.profiles import ColumnProfilerRunner
+
+    table = read_csv(TITANIC)
+    profiles = ColumnProfilerRunner.on_data(table).run()
+    assert profiles.num_records == 891
+    sex = profiles.profiles["Sex"]
+    assert sex.histogram is not None
+    assert sex.histogram["male"].absolute == 577
+
+
+def test_parquet_roundtrip(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    arrow = pa.table(
+        {
+            "a": [1, 2, None, 4],
+            "b": [1.5, None, 3.5, 4.5],
+            "c": ["x", "y", None, "x"],
+            "d": [True, False, True, None],
+        }
+    )
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(arrow, path)
+    table = read_parquet(path)
+    assert table.num_rows == 4
+    assert table["a"].dtype == DType.INTEGRAL
+    assert table["a"].to_pylist() == [1, 2, None, 4]
+    assert table["b"].to_pylist() == [1.5, None, 3.5, 4.5]
+    assert table["c"].to_pylist() == ["x", "y", None, "x"]
+    assert table["d"].to_pylist() == [True, False, True, None]
+
+
+def test_from_pandas():
+    pd = pytest.importorskip("pandas")
+
+    df = pd.DataFrame({"x": [1.0, np.nan, 3.0], "s": ["a", None, "b"]})
+    table = from_pandas(df)
+    assert table["x"].to_pylist() == [1.0, None, 3.0]
+    assert table["s"].to_pylist() == ["a", None, "b"]
+
+
+def test_numerical_distance_identical():
+    s1 = KLLSketchState()
+    s2 = KLLSketchState()
+    data = np.random.default_rng(0).normal(size=5000)
+    s1.update_batch(data)
+    s2.update_batch(data)
+    assert numerical_distance(s1, s2, correct_for_low_number_of_samples=True) == 0.0
+
+
+def test_numerical_distance_shifted():
+    s1 = KLLSketchState()
+    s2 = KLLSketchState()
+    rng = np.random.default_rng(0)
+    s1.update_batch(rng.normal(0, 1, 5000))
+    s2.update_batch(rng.normal(3, 1, 5000))
+    d = numerical_distance(s1, s2, correct_for_low_number_of_samples=True)
+    assert d > 0.8  # 3-sigma shift -> nearly disjoint CDFs
+
+
+def test_categorical_distance():
+    a = {"x": 50, "y": 50}
+    b = {"x": 50, "y": 50}
+    assert categorical_distance(a, b, correct_for_low_number_of_samples=True) == 0.0
+    c = {"x": 100}
+    d = categorical_distance(a, c, correct_for_low_number_of_samples=True)
+    assert d == 0.5
+    # robust correction subtracts the KS small-sample term
+    robust = categorical_distance(a, c)
+    assert robust < d
