@@ -335,9 +335,17 @@ class _LengthAnalyzer(StandardScanAnalyzer):
         identity = np.inf if tag == "min" else -np.inf
 
         def update(vals, row_valid, xp, n):
+            from deequ_tpu import native
+
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
-            lut = np.array([float(len(s)) for s in v.dictionary], dtype=np.float64)
+            native_lengths = native.utf8_lengths(v.dictionary)
+            if native_lengths is not None:
+                lut = native_lengths.astype(np.float64)
+            else:
+                lut = np.array(
+                    [float(len(s)) for s in v.dictionary], dtype=np.float64
+                )
             if len(lut) == 0:
                 lut = np.zeros(1, dtype=np.float64)
             lengths = xp.asarray(lut)[xp.maximum(v.data, 0)]
@@ -579,6 +587,17 @@ def _classify_string(s: str) -> int:
     return 4
 
 
+def _classify_dictionary(values) -> np.ndarray:
+    """Classify all distinct values: C++ batch kernel when available,
+    regex fallback otherwise (identical outputs, asserted by tests)."""
+    from deequ_tpu import native
+
+    classes = native.classify_strings(values)
+    if classes is not None:
+        return classes
+    return np.array([_classify_string(s) for s in values], dtype=np.int32)
+
+
 @dataclass(frozen=True)
 class DataType(ScanShareableAnalyzer):
     """Per-value type inference histogram (reference analyzers/DataType.scala).
@@ -605,9 +624,7 @@ class DataType(ScanShareableAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             if dtype == DType.STRING:
-                lut = np.array(
-                    [_classify_string(s) for s in v.dictionary], dtype=np.int32
-                )
+                lut = _classify_dictionary(v.dictionary)
                 if len(lut) == 0:
                     lut = np.zeros(1, dtype=np.int32)
                 classes = xp.where(

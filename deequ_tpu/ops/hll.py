@@ -107,7 +107,13 @@ def xxhash64_bytes(data: bytes, seed: int = XXHASH_SEED) -> int:
 
 
 def hash_strings(values, seed: int = XXHASH_SEED) -> np.ndarray:
-    """xxhash64 per distinct string (host, O(cardinality))."""
+    """xxhash64 per distinct string (host, O(cardinality)); uses the C++
+    batch kernel when available (deequ_tpu/native), bit-identical fallback."""
+    from deequ_tpu import native
+
+    hashed = native.hash_strings(values, seed)
+    if hashed is not None:
+        return hashed
     return np.array(
         [xxhash64_bytes(str(v).encode("utf-8"), seed) for v in values],
         dtype=np.uint64,
