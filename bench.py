@@ -64,11 +64,17 @@ def main():
     from deequ_tpu.analyzers.runner import AnalysisRunner
     from deequ_tpu.ops.scan_engine import SCAN_STATS
 
+    from deequ_tpu.ops.scan_engine import _auto_chunk_rows
+
     table = build_table()
     analyzers = build_analyzers()
 
-    # warmup: compile the fused program on a small slice
-    AnalysisRunner.do_analysis_run(table.head(1 << 16), analyzers)
+    # warmup: compile the fused program with the SAME chunk geometry the
+    # timed run will use (a different shape would recompile inside the
+    # timed region)
+    needed = sorted({c for a in analyzers for c in a.scan_op(table).columns})
+    chunk_rows = min(_auto_chunk_rows({n: table[n] for n in needed}), N_ROWS)
+    AnalysisRunner.do_analysis_run(table.head(chunk_rows), analyzers)
 
     SCAN_STATS.reset()
     t0 = time.time()

@@ -1,0 +1,33 @@
+"""Analysis — an ordered bag of analyzers (reference analyzers/Analysis.
+scala:29-63; deprecated there in favor of AnalysisRunBuilder, kept for API
+parity)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+from deequ_tpu.analyzers.base import Analyzer
+from deequ_tpu.data.table import ColumnarTable
+
+
+@dataclass(frozen=True)
+class Analysis:
+    analyzers: tuple = ()
+
+    def add_analyzer(self, analyzer: Analyzer) -> "Analysis":
+        return Analysis(self.analyzers + (analyzer,))
+
+    def add_analyzers(self, analyzers: Sequence[Analyzer]) -> "Analysis":
+        return Analysis(self.analyzers + tuple(analyzers))
+
+    def run(self, data: ColumnarTable, aggregate_with=None, save_states_with=None):
+        """Compute metrics (deprecated entry; delegates to AnalysisRunner)."""
+        from deequ_tpu.analyzers.runner import AnalysisRunner
+
+        return AnalysisRunner.do_analysis_run(
+            data,
+            list(self.analyzers),
+            aggregate_with=aggregate_with,
+            save_states_with=save_states_with,
+        )

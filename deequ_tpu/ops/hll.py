@@ -139,8 +139,13 @@ def _f64_key_u64(values, xp):
     hashing. Host numpy uses the identical formula so states computed on
     different platforms merge consistently."""
     canonical = values + 0.0  # fold -0.0 into +0.0
-    hi = canonical.astype(xp.float32)
-    lo = (canonical - hi.astype(xp.float64)).astype(xp.float32)
+    if xp is np:
+        with np.errstate(over="ignore", invalid="ignore"):
+            hi = canonical.astype(np.float32)  # |x| > f32 max folds to inf
+            lo = (canonical - hi.astype(np.float64)).astype(np.float32)
+    else:
+        hi = canonical.astype(xp.float32)
+        lo = (canonical - hi.astype(xp.float64)).astype(xp.float32)
     if xp is np:
         hi_bits = hi.view(np.uint32).astype(np.uint64)
         lo_bits = lo.view(np.uint32).astype(np.uint64)

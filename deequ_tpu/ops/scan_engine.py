@@ -42,6 +42,20 @@ from deequ_tpu.expr.eval import Val
 from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh
 
 DEFAULT_CHUNK_ROWS = 1 << 20
+# target bytes per packed chunk transfer: large enough to amortize the
+# per-transfer latency of slow host<->device links, small enough to
+# double-buffer comfortably in HBM
+DEFAULT_CHUNK_BYTES = 512 << 20
+MAX_CHUNK_ROWS = 1 << 23
+
+
+def _auto_chunk_rows(cols: Dict[str, Column]) -> int:
+    bytes_per_row = 0
+    for col in cols.values():
+        bytes_per_row += 4 if col.dtype == DType.STRING else 9  # f64 + mask
+    bytes_per_row = max(bytes_per_row, 1)
+    rows = DEFAULT_CHUNK_BYTES // bytes_per_row
+    return int(min(max(rows, 1 << 18), MAX_CHUNK_ROWS))
 
 
 @dataclass
@@ -187,7 +201,7 @@ def run_scan(
     cols = {name: table[name] for name in needed}
 
     n_dev = math.prod(mesh.devices.shape) if mesh is not None else 1
-    chunk = chunk_rows or min(DEFAULT_CHUNK_ROWS, max(n_rows, 1))
+    chunk = chunk_rows or min(_auto_chunk_rows(cols), max(n_rows, 1))
     # static shapes: round the chunk up so it splits evenly across devices
     chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
 

@@ -1,0 +1,111 @@
+"""KLL sketch accuracy/property tests (analogue of KLL/KLLProbTest.scala,
+KLLDistanceTest.scala): rank/CDF/quantile error bounds, merge correctness,
+serialization round-trips."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.ops.kll import KLLSketchState
+
+
+def rank_error(sketch, data):
+    """Max relative rank error over sampled query points."""
+    data_sorted = np.sort(data)
+    n = len(data)
+    errs = []
+    for q in np.linspace(0.01, 0.99, 25):
+        value = data_sorted[int(q * (n - 1))]
+        true_rank = np.searchsorted(data_sorted, value, side="right")
+        est_rank = sketch.rank(value)
+        errs.append(abs(est_rank - true_rank) / n)
+    return max(errs)
+
+
+def test_rank_accuracy_uniform():
+    rng = np.random.default_rng(0)
+    data = rng.uniform(0, 1, 100_000)
+    sketch = KLLSketchState()
+    sketch.update_batch(data)
+    assert rank_error(sketch, data) < 0.02
+
+
+def test_rank_accuracy_lognormal():
+    rng = np.random.default_rng(1)
+    data = rng.lognormal(0, 2, 100_000)
+    sketch = KLLSketchState()
+    sketch.update_batch(data)
+    assert rank_error(sketch, data) < 0.02
+
+
+def test_quantile_accuracy():
+    data = np.arange(50_000, dtype=float)
+    rng = np.random.default_rng(2)
+    rng.shuffle(data)
+    sketch = KLLSketchState()
+    for start in range(0, len(data), 1000):  # streaming updates
+        sketch.update_batch(data[start:start + 1000])
+    for q in (0.1, 0.25, 0.5, 0.75, 0.9, 0.99):
+        est = sketch.quantile(q)
+        assert abs(est - q * 50_000) < 50_000 * 0.02, (q, est)
+
+
+def test_merge_matches_combined():
+    rng = np.random.default_rng(3)
+    a_data = rng.normal(0, 1, 30_000)
+    b_data = rng.normal(5, 2, 30_000)
+    a = KLLSketchState()
+    a.update_batch(a_data)
+    b = KLLSketchState()
+    b.update_batch(b_data)
+    merged = a.merge(b)
+    combined = np.sort(np.concatenate([a_data, b_data]))
+    assert merged.count == 60_000
+    n = len(combined)
+    for q in (0.1, 0.5, 0.9):
+        est = merged.quantile(q)
+        true = combined[int(q * (n - 1))]
+        true_rank = np.searchsorted(combined, est) / n
+        assert abs(true_rank - q) < 0.025, (q, est, true)
+
+
+def test_merge_weight_exact():
+    a = KLLSketchState(sketch_size=64)
+    b = KLLSketchState(sketch_size=64)
+    a.update_batch(np.arange(7777, dtype=float))
+    b.update_batch(np.arange(3333, dtype=float))
+    m = a.merge(b)
+    assert m.rank(1e12) == 7777 + 3333  # total weight conserved through merges
+
+
+def test_serialization_roundtrip():
+    rng = np.random.default_rng(4)
+    sketch = KLLSketchState(sketch_size=256, shrinking_factor=0.5)
+    sketch.update_batch(rng.normal(size=20_000))
+    data = sketch.serialize()
+    back = KLLSketchState.deserialize(data)
+    assert back.count == sketch.count
+    assert back.sketch_size == 256
+    assert back.shrinking_factor == 0.5
+    for q in (0.1, 0.5, 0.9):
+        assert back.quantile(q) == sketch.quantile(q)
+
+
+def test_reconstruct_from_bucket_distribution_data():
+    """BucketDistribution.data/.parameters rebuild a queryable sketch
+    (reference KLLMetric.computePercentiles path)."""
+    from deequ_tpu.analyzers import KLLSketch
+    from deequ_tpu.data.table import ColumnarTable
+
+    t = ColumnarTable.from_pydict({"x": [float(i) for i in range(2000)]})
+    dist = KLLSketch("x").calculate(t).value.get()
+    percentiles = dist.compute_percentiles()
+    assert len(percentiles) == 100
+    assert percentiles == sorted(percentiles)
+    assert abs(percentiles[49] - 1000) < 100
+
+
+def test_empty_sketch():
+    sketch = KLLSketchState()
+    assert np.isnan(sketch.quantile(0.5))
+    assert sketch.rank(10.0) == 0
+    assert sketch.count == 0
