@@ -67,6 +67,22 @@ from deequ_tpu.verification import (  # noqa: E402
 
 __version__ = "0.1.0"
 
+
+def execution_report() -> dict:
+    """Engine execution report: fused scan passes, grouping/KLL passes,
+    rows/bytes scanned, and scan wall time since the last reset. The
+    first-class analogue of the reference's test-only SparkMonitor job
+    accounting (SURVEY.md §5)."""
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    return SCAN_STATS.snapshot()
+
+
+def reset_execution_report() -> None:
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.reset()
+
 __all__ = [
     "Check",
     "CheckLevel",

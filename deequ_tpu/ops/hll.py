@@ -178,7 +178,9 @@ def registers_from_hashes(hashes, valid, p: int, xp):
     """Fold a chunk of 64-bit hashes into an HLL register file on device.
 
     idx = top p bits, rank = clz(remaining bits) + 1; registers take the max
-    rank per idx via segment_max. Invalid rows contribute rank 0.
+    rank per idx. Invalid rows contribute rank 0. Two lowering paths:
+    XLA segment_max (default) or the Pallas compare-select kernel
+    (ops/pallas_kernels.py, DEEQU_TPU_PALLAS=1).
     """
     import jax
 
@@ -189,6 +191,19 @@ def registers_from_hashes(hashes, valid, p: int, xp):
     rank = xp.minimum(rank, 64 - p + 1)
     rank = xp.where(valid, rank, 0)
     idx = xp.where(valid, idx, 0)
+
+    if xp is not np:
+        from deequ_tpu.ops import pallas_kernels
+
+        # NOTE: native TPU lowering is blocked in this environment — the
+        # tunnel's compile helper crashes on ANY Pallas grid-accumulation
+        # kernel (verified with a minimal repro; see ops/pallas_kernels.py
+        # docstring) — so the Pallas path currently runs interpret-mode only
+        if pallas_kernels.pallas_enabled() and jax.devices()[0].platform == "cpu":
+            return pallas_kernels.hll_fold(
+                idx, rank, num_registers=m, interpret=True
+            )
+
     regs = jax.ops.segment_max(
         rank, idx, num_segments=m, indices_are_sorted=False
     ).astype(xp.int32)

@@ -1,0 +1,85 @@
+"""Pallas TPU kernels for sketch hot loops.
+
+The engine's reductions fuse well under plain XLA, but the HLL register
+fold is a scatter-max — XLA lowers ``segment_max`` to a serial scatter on
+TPU. This kernel reformulates it as a dense VPU compare-select over
+(registers, 8, 128) tiles streamed through VMEM, accumulating the register
+file across sequential grid steps (init on step 0 via ``pl.when``).
+
+TPU constraints honored (and discovered the hard way on the tunnel
+compiler): int32 blocks must tile to (8, 128); bool ``jnp.where`` selects
+recurse in this Mosaic version, so selection is arithmetic; everything is
+64-bit-free.
+
+STATUS: correct under interpret mode (tested); native TPU lowering is
+blocked by this environment's remote compile helper, which SIGABRTs on any
+grid-accumulation kernel (minimal repro: a 2-step grid maximum over (8,128)
+int32 tiles with pl.when init). The engine therefore keeps XLA segment_max
+as the TPU production path and uses this kernel only where Pallas compiles.
+Enable with DEEQU_TPU_PALLAS=1.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# rows processed per grid step: one (8, 128) int32 tile
+TILE_ROWS = 8 * 128
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("DEEQU_TPU_PALLAS", "0") == "1"
+
+
+def _fold_kernel(idx_ref, rank_ref, out_ref, *, num_registers: int):
+    from jax.experimental import pallas as pl
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    idx = idx_ref[:]    # (8, 128) i32
+    rank = rank_ref[:]  # (8, 128) i32
+    regs = jax.lax.broadcasted_iota(
+        jnp.int32, (num_registers, 8, 128), 0
+    )
+    # arithmetic select (bool jnp.where recurses in this Mosaic lowering)
+    contrib = (idx[None, :, :] == regs).astype(jnp.int32) * rank[None, :, :]
+    block_max = jnp.max(contrib, axis=(1, 2))  # (R,)
+    update = jnp.broadcast_to(block_max[None, :], out_ref.shape)
+    out_ref[:] = jnp.maximum(out_ref[:], update)
+
+
+@functools.partial(jax.jit, static_argnames=("num_registers", "interpret"))
+def hll_fold(idx, rank, num_registers: int = 512, interpret: bool = False):
+    """Fold (idx, rank) pairs into an HLL register file: out[r] = max rank
+    over rows with idx == r (invalid rows must carry rank 0).
+    ``num_registers`` must be a multiple of 128 (HLL p >= 7)."""
+    from jax.experimental import pallas as pl
+
+    assert num_registers % 128 == 0, "num_registers must be a lane multiple"
+    n = idx.shape[0]
+    pad = (-n) % TILE_ROWS
+    idx2 = jnp.pad(idx.astype(jnp.int32), (0, pad)).reshape(-1, 128)
+    rank2 = jnp.pad(rank.astype(jnp.int32), (0, pad)).reshape(-1, 128)
+    grid = (idx2.shape[0] // 8,)
+
+    out = pl.pallas_call(
+        functools.partial(_fold_kernel, num_registers=num_registers),
+        out_shape=jax.ShapeDtypeStruct((8, num_registers), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, num_registers), lambda i: (0, 0)),
+        interpret=interpret,
+    )(idx2, rank2)
+    return out[0]

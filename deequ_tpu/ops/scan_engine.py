@@ -69,8 +69,10 @@ class ScanOp:
 
 class ScanStats:
     """Execution-report counters — the analogue of the reference's test-only
-    SparkMonitor job accounting (SparkMonitor.scala:55-80), but first-class:
-    tests assert fusion by counting device passes."""
+    SparkMonitor job accounting (SparkMonitor.scala:55-80), but first-class
+    (SURVEY.md §5 calls for an execution-report hook): fused-pass counts,
+    rows/bytes scanned, and wall time per pass. Tests assert fusion by
+    counting device passes; users read it via deequ_tpu.execution_report()."""
 
     def __init__(self):
         self.reset()
@@ -79,8 +81,10 @@ class ScanStats:
         self.scan_passes = 0
         self.chunks_processed = 0
         self.rows_scanned = 0
+        self.bytes_packed = 0
         self.grouping_passes = 0
         self.kll_passes = 0
+        self.scan_seconds = 0.0
 
     def snapshot(self) -> dict:
         return dict(self.__dict__)
@@ -316,12 +320,16 @@ def run_scan(
     else:
         put = jax.device_put
 
+    import time as _time
+
+    t_start = _time.time()
     in_flight = []
     window = 3
     for ci in range(n_chunks):
         start = ci * chunk
         stop = min(start + chunk, n_rows)
         args = packer.pack(start, stop)
+        SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
         if shapes is None:
             shapes = jax.eval_shape(shape_fn, *args)
         in_flight.append(step_fn(*put(args)))
@@ -329,4 +337,5 @@ def run_scan(
             drain(in_flight.pop(0))
     for device_result in in_flight:
         drain(device_result)
+    SCAN_STATS.scan_seconds += _time.time() - t_start
     return merged

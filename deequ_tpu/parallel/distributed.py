@@ -1,0 +1,50 @@
+"""Multi-host initialization (the DCN story; SURVEY.md §2.15).
+
+The engine itself is topology-agnostic: it runs over whatever mesh
+``parallel.mesh.current_mesh()`` resolves. On a multi-host TPU slice, call
+``initialize_multi_host()`` once per process before building tables; the
+default mesh then spans every chip in the slice and the scan engine's
+collectives (psum/pmin/pmax/all_gather) ride ICI inside a slice and DCN
+across slices — XLA routes them, exactly as the design requires (no NCCL/
+MPI analogue needed).
+
+Data distribution across hosts follows the standard jax convention: each
+host feeds its local shard of rows (``host_row_range``), and the global
+monoid merge makes per-host partial states combine exactly like per-device
+partials.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+
+def initialize_multi_host(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize jax.distributed for a multi-host run. On Cloud TPU the
+    arguments are auto-detected from the environment; pass them explicitly
+    elsewhere."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def host_row_range(total_rows: int) -> Tuple[int, int]:
+    """The [start, stop) slice of a globally-ordered dataset this host
+    should ingest, balanced across processes."""
+    n_proc = jax.process_count()
+    pid = jax.process_index()
+    per_host = (total_rows + n_proc - 1) // n_proc
+    start = min(pid * per_host, total_rows)
+    stop = min(start + per_host, total_rows)
+    return start, stop
