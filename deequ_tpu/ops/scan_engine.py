@@ -144,6 +144,12 @@ class _ChunkPacker:
             n for n, c in cols.items() if c.dtype != DType.STRING
         ]
         self.string_names = [n for n, c in cols.items() if c.dtype == DType.STRING]
+        # null-free columns don't ship a mask row at all — their validity is
+        # just row_valid (saves 1 byte/row/column of transfer)
+        self.masked_names = [
+            n for n in self.numeric_names if not bool(cols[n].mask.all())
+        ]
+        self._mask_row = {n: i for i, n in enumerate(self.masked_names)}
         self.cols = cols
         self.chunk = chunk
 
@@ -151,7 +157,7 @@ class _ChunkPacker:
         chunk = self.chunk
         n = stop - start
         values = np.empty((max(len(self.numeric_names), 1), chunk), dtype=np.float64)
-        masks = np.empty((max(len(self.numeric_names), 1), chunk), dtype=np.bool_)
+        masks = np.empty((max(len(self.masked_names), 1), chunk), dtype=np.bool_)
         codes = np.empty((max(len(self.string_names), 1), chunk), dtype=np.int32)
         if n < chunk:  # pad only the tail chunk
             values[:, n:] = 0.0
@@ -159,28 +165,35 @@ class _ChunkPacker:
             codes[:, n:] = -1
         if not self.numeric_names:
             values[:, :n] = 0.0
+        if not self.masked_names:
             masks[:, :n] = False
         if not self.string_names:
             codes[:, :n] = -1
         for i, name in enumerate(self.numeric_names):
-            col = self.cols[name]
-            values[i, :n] = col.values[start:stop]
-            masks[i, :n] = col.mask[start:stop]
+            values[i, :n] = self.cols[name].values[start:stop]
+        for name, i in self._mask_row.items():
+            masks[i, :n] = self.cols[name].mask[start:stop]
         for j, name in enumerate(self.string_names):
             codes[j, :n] = self.cols[name].codes[start:stop]
         row_valid = np.zeros(chunk, dtype=np.bool_)
         row_valid[:n] = True
         return values, masks, codes, row_valid
 
-    def unpack_vals(self, values, masks, codes, xp) -> Dict[str, Val]:
+    def unpack_vals(self, values, masks, codes, xp, row_valid=None) -> Dict[str, Val]:
         """Slice the packed buffers back into per-column Vals (inside jit)."""
         vals: Dict[str, Val] = {}
         for i, name in enumerate(self.numeric_names):
             col = self.cols[name]
-            if col.dtype == DType.BOOLEAN:
-                vals[name] = Val("bool", values[i] != 0.0, masks[i])
+            if name in self._mask_row:
+                mask = masks[self._mask_row[name]]
+            elif row_valid is not None:
+                mask = row_valid
             else:
-                vals[name] = Val("num", values[i], masks[i])
+                mask = xp.ones(values[i].shape, dtype=bool)
+            if col.dtype == DType.BOOLEAN:
+                vals[name] = Val("bool", values[i] != 0.0, mask)
+            else:
+                vals[name] = Val("num", values[i], mask)
         for j, name in enumerate(self.string_names):
             vals[name] = Val(
                 "str", codes[j], None, dictionary=self.cols[name].dictionary
@@ -213,7 +226,7 @@ def run_scan(
     local_n = chunk // n_dev if mesh is not None else chunk
 
     def step(values, masks, codes, row_valid):
-        vals = packer.unpack_vals(values, masks, codes, jnp)
+        vals = packer.unpack_vals(values, masks, codes, jnp, row_valid)
         partials = tuple(op.update(vals, row_valid, jnp, local_n) for op in ops)
         if mesh is not None:
             partials = tuple(
