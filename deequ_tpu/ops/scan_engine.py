@@ -271,8 +271,10 @@ def run_scan(
     packer = _ChunkPacker(cols, chunk)
     local_n = chunk // n_dev if mesh is not None else chunk
 
-    def step(values, masks, codes, row_valid):
-        vals = packer.unpack_vals(values, masks, codes, jnp, row_valid)
+    def step(values, narrow_i, narrow_f, masks, codes, row_valid):
+        vals = packer.unpack_vals(
+            values, narrow_i, narrow_f, masks, codes, jnp, row_valid
+        )
         partials = tuple(op.update(vals, row_valid, jnp, local_n) for op in ops)
         if mesh is not None:
             partials = tuple(
@@ -294,8 +296,8 @@ def run_scan(
     # a fused scan easily produces hundreds of small state leaves. Flatten
     # everything into ONE f64 vector on device and fetch once per chunk
     # (f64 is lossless for all state leaves: counts < 2^53, registers i32).
-    def step_flat(values, masks, codes, row_valid):
-        partials = step(values, masks, codes, row_valid)
+    def step_flat(values, narrow_i, narrow_f, masks, codes, row_valid):
+        partials = step(values, narrow_i, narrow_f, masks, codes, row_valid)
         leaves = jax.tree.leaves(partials)
         return jnp.concatenate(
             [jnp.ravel(leaf).astype(jnp.float64) for leaf in leaves]
@@ -317,14 +319,15 @@ def run_scan(
             mesh=mesh,
             in_specs=(
                 P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
+                P(None, ROW_AXIS), P(None, ROW_AXIS),
                 P(ROW_AXIS),
             ),
             out_specs=P(),
             check_vma=False,
         )
 
-        def flat_outer(values, masks, codes, row_valid):
-            partials = inner(values, masks, codes, row_valid)
+        def flat_outer(values, narrow_i, narrow_f, masks, codes, row_valid):
+            partials = inner(values, narrow_i, narrow_f, masks, codes, row_valid)
             leaves = jax.tree.leaves(partials)
             return jnp.concatenate(
                 [jnp.ravel(leaf).astype(jnp.float64) for leaf in leaves]
@@ -366,6 +369,8 @@ def run_scan(
         from jax.sharding import NamedSharding
 
         arg_shardings = (
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
             NamedSharding(mesh, P(None, ROW_AXIS)),
             NamedSharding(mesh, P(None, ROW_AXIS)),
             NamedSharding(mesh, P(None, ROW_AXIS)),
