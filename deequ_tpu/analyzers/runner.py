@@ -233,7 +233,11 @@ class AnalysisRunner:
         scannable = []
         for analyzer in analyzers:
             try:
-                ops.append(analyzer.scan_op(data))
+                op = analyzer.scan_op(data)
+                # analyzers are hashable value objects: their identity keys
+                # the traced-program cache for repeated runs (scan_engine)
+                op.cache_key = analyzer
+                ops.append(op)
                 scannable.append(analyzer)
             except Exception as e:  # noqa: BLE001
                 ctx.metric_map[analyzer] = analyzer.to_failure_metric(

@@ -121,10 +121,13 @@ def from_arrow(table) -> ColumnarTable:
             values = np.asarray(combined.fill_null(0), dtype=np.int64)
             cols.append(Column(name, DType.INTEGRAL, values=values, mask=mask))
         elif pa.types.is_floating(pa_type):
-            mask = ~np.asarray(combined.is_null())
-            values = np.nan_to_num(
-                np.asarray(combined.fill_null(0.0), dtype=np.float64)
-            ) * mask
+            # Arrow distinguishes null from NaN; the engine's convention
+            # (matching from_pandas) is NaN == null: fold isnan into the
+            # mask so valid NaNs never silently become 0.0 values.
+            # +/-inf stays a valid value (as in Spark).
+            arr = np.asarray(combined.fill_null(np.nan), dtype=np.float64)
+            mask = ~np.isnan(arr)
+            values = np.where(mask, arr, 0.0)
             cols.append(Column(name, DType.FRACTIONAL, values=values, mask=mask))
         elif pa.types.is_boolean(pa_type):
             mask = ~np.asarray(combined.is_null())
@@ -154,10 +157,11 @@ def from_pandas(df) -> ColumnarTable:
         elif pd.api.types.is_float_dtype(series.dtype):
             arr = series.to_numpy(dtype=np.float64)
             mask = ~np.isnan(arr)
+            # zero only the null (NaN) slots; +/-inf stays a valid value
             cols.append(
                 Column(
                     str(name), DType.FRACTIONAL,
-                    values=np.nan_to_num(arr), mask=mask,
+                    values=np.where(mask, arr, 0.0), mask=mask,
                 )
             )
         elif pd.api.types.is_bool_dtype(series.dtype):

@@ -178,6 +178,27 @@ class ColumnarTable:
         if len(lengths) > 1:
             raise ValueError(f"ragged columns: {lengths}")
         self.num_rows = lengths.pop() if lengths else 0
+        self._device_cache = None  # set by persist()
+
+    # -- device residency (the analogue of Spark df.persist()) --------------
+
+    def persist(self, mesh=None) -> "ColumnarTable":
+        """Pack + transfer all columns to device HBM once; subsequent scans
+        stream from HBM instead of re-shipping host bytes. Multi-pass
+        workloads (profiler, repeated verification) become compute-bound."""
+        from deequ_tpu.ops.scan_engine import persist_table
+
+        persist_table(self, mesh=mesh)
+        return self
+
+    def unpersist(self) -> "ColumnarTable":
+        """Release the device-resident buffers."""
+        self._device_cache = None
+        return self
+
+    @property
+    def is_persisted(self) -> bool:
+        return self._device_cache is not None
 
     # -- constructors -------------------------------------------------------
 

@@ -74,7 +74,11 @@ class KLLSketchState:
                 continue
             if level + 1 == len(self.compactors):
                 self.compactors.append(np.empty(0, dtype=np.float64))
-                # capacities shift when a level is added; re-check from here
+                # appending a level shrinks the depth-based capacities of
+                # every lower level — restart the walk from 0 so all buffers
+                # end within capacity (QuantileNonSample capacity invariant)
+                level = 0
+                continue
             buf = np.sort(buf)
             # an odd-length buffer keeps one leftover item at this level so
             # total weight is preserved exactly; the even remainder compacts

@@ -28,6 +28,7 @@ elementwise max, DataType histogram via vector sum). KLL gets its own pass
 from __future__ import annotations
 
 import math
+import weakref
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -49,13 +50,17 @@ DEFAULT_CHUNK_BYTES = 512 << 20
 MAX_CHUNK_ROWS = 1 << 23
 
 
-def _auto_chunk_rows(cols: Dict[str, Column]) -> int:
+def _auto_chunk_rows(
+    cols: Dict[str, Column],
+    target_bytes: int = DEFAULT_CHUNK_BYTES,
+    max_rows: int = MAX_CHUNK_ROWS,
+) -> int:
     bytes_per_row = 0
     for col in cols.values():
         bytes_per_row += 4 if col.dtype == DType.STRING else 9  # f64 + mask
     bytes_per_row = max(bytes_per_row, 1)
-    rows = DEFAULT_CHUNK_BYTES // bytes_per_row
-    return int(min(max(rows, 1 << 18), MAX_CHUNK_ROWS))
+    rows = target_bytes // bytes_per_row
+    return int(min(max(rows, 1 << 18), max_rows))
 
 
 @dataclass
@@ -65,6 +70,12 @@ class ScanOp:
     columns: Tuple[str, ...]
     update: Callable[[Dict[str, Val], Any, Any, int], Any]
     tags: Any  # pytree matching update's output; leaves: 'sum'|'min'|'max'
+    # identity of the analyzer that built this op (hashable); lets the
+    # engine reuse the traced+compiled fused program across repeated runs
+    # over the same persisted table (retracing a 100-op program costs
+    # seconds of host Python — the analogue of Spark reusing a compiled
+    # whole-stage-codegen plan)
+    cache_key: Any = None
 
 
 class ScanStats:
@@ -85,6 +96,10 @@ class ScanStats:
         self.grouping_passes = 0
         self.kll_passes = 0
         self.scan_seconds = 0.0
+        self.resident_passes = 0
+        self.bytes_resident = 0
+        self.programs_built = 0
+        self.programs_reused = 0
 
     def snapshot(self) -> dict:
         return dict(self.__dict__)
@@ -247,6 +262,132 @@ class _ChunkPacker:
         return vals
 
 
+class DeviceTableCache:
+    """Packed table chunks resident in HBM — the analogue of Spark's
+    ``df.persist()`` (StorageLevel.MEMORY) that the reference leans on for
+    its multi-pass profiler (AnalysisRunner.scala:493-497).
+
+    The TPU tunnel moves novel bytes at ~33MB/s, so on this link any
+    multi-pass workload (the 3-pass ColumnProfiler, repeated verification
+    runs, incremental re-checks) is transfer-bound unless the table ships
+    ONCE. persist() packs every column with the same _ChunkPacker layout
+    the scan uses and device_puts the buffers with the mesh shardings;
+    subsequent run_scan calls stream straight from HBM.
+    """
+
+    MAX_RESIDENT_BYTES = 12 << 30  # leave headroom in 16GB v5e HBM
+    MAX_CACHED_PROGRAMS = 32  # LRU cap on traced programs per table
+
+    def __init__(self, packer, chunk, device_chunks, mesh, nbytes, device_count):
+        self.packer = packer
+        self.chunk = chunk
+        self.device_chunks = device_chunks  # list of 6-tuples of device arrays
+        self.mesh = mesh
+        self.nbytes = nbytes
+        self.device_count = device_count
+        # (op cache_keys, chunk) -> (step_fn, shapes): reused traced
+        # programs, LRU-bounded so long-lived services with varied analyzer
+        # sets don't accumulate executables without limit
+        self.programs: Dict[Any, Any] = {}
+        _ACTIVE_CACHES.add(self)
+
+    def get_program(self, key):
+        prog = self.programs.pop(key, None)
+        if prog is not None:
+            self.programs[key] = prog  # re-insert: most-recently-used
+        return prog
+
+    def put_program(self, key, prog) -> None:
+        self.programs[key] = prog
+        while len(self.programs) > self.MAX_CACHED_PROGRAMS:
+            self.programs.pop(next(iter(self.programs)))
+
+    def matches(self, mesh, needed_cols) -> bool:
+        same_mesh = (
+            (mesh is None and self.mesh is None)
+            or (
+                mesh is not None
+                and self.mesh is not None
+                and mesh.devices.shape == self.mesh.devices.shape
+                and tuple(mesh.devices.flat) == tuple(self.mesh.devices.flat)
+            )
+        )
+        return same_mesh and set(needed_cols) <= set(self.packer.cols)
+
+
+# Live caches (weakly held): persist() checks the COMBINED resident
+# footprint — e.g. the profiler holding both the raw and the numeric-cast
+# table — against the HBM budget, not just the newest table's size.
+_ACTIVE_CACHES: "weakref.WeakSet[DeviceTableCache]" = weakref.WeakSet()
+
+
+def total_resident_bytes() -> int:
+    return sum(c.nbytes for c in _ACTIVE_CACHES)
+
+
+def persist_table(
+    table: ColumnarTable,
+    mesh=None,
+    chunk_rows: Optional[int] = None,
+    max_bytes: int = DeviceTableCache.MAX_RESIDENT_BYTES,
+) -> DeviceTableCache:
+    """Pack ALL columns of the table and transfer them to device HBM once.
+
+    Returns the cache and attaches it to ``table._device_cache`` so every
+    subsequent ``run_scan`` over this table skips host packing + transfer.
+    """
+    if mesh is None:
+        mesh = current_mesh()
+    cols = {name: table[name] for name in table.column_names}
+    n_rows = table.num_rows
+    n_dev = math.prod(mesh.devices.shape) if mesh is not None else 1
+    # resident chunks can be much larger than streaming ones: every extra
+    # chunk costs a device dispatch + result fetch (~0.1-0.3s each over the
+    # tunnel), and HBM holds the whole table anyway
+    chunk = chunk_rows or min(
+        _auto_chunk_rows(cols, target_bytes=2 << 30, max_rows=1 << 25),
+        max(n_rows, 1),
+    )
+    chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
+
+    packer = _ChunkPacker(cols, chunk)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        shardings = (
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(None, ROW_AXIS)),
+            NamedSharding(mesh, P(ROW_AXIS)),
+        )
+
+        def put(args):
+            return tuple(jax.device_put(a, s) for a, s in zip(args, shardings))
+    else:
+        put = jax.device_put
+
+    n_chunks = max(1, (n_rows + chunk - 1) // chunk)
+    device_chunks = []
+    nbytes = 0
+    for ci in range(n_chunks):
+        start = ci * chunk
+        stop = min(start + chunk, n_rows)
+        args = packer.pack(start, stop)
+        nbytes += sum(a.nbytes for a in args)
+        if nbytes + total_resident_bytes() > max_bytes:
+            raise MemoryError(
+                f"persist_table: combined resident size would exceed "
+                f"{max_bytes} bytes; stream instead or raise max_bytes"
+            )
+        device_chunks.append(put(args))
+    jax.block_until_ready(device_chunks)
+    cache = DeviceTableCache(packer, chunk, device_chunks, mesh, nbytes, n_dev)
+    table._device_cache = cache
+    return cache
+
+
 def run_scan(
     table: ColumnarTable,
     ops: Sequence[ScanOp],
@@ -264,11 +405,23 @@ def run_scan(
     cols = {name: table[name] for name in needed}
 
     n_dev = math.prod(mesh.devices.shape) if mesh is not None else 1
-    chunk = chunk_rows or min(_auto_chunk_rows(cols), max(n_rows, 1))
-    # static shapes: round the chunk up so it splits evenly across devices
-    chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
 
-    packer = _ChunkPacker(cols, chunk)
+    # device-resident fast path: table was persist()ed with a compatible
+    # mesh — stream chunks straight from HBM, no packing, no transfer
+    cache = getattr(table, "_device_cache", None)
+    if cache is not None and not cache.matches(mesh, needed):
+        cache = None
+    if cache is not None and chunk_rows is not None and chunk_rows != cache.chunk:
+        cache = None
+
+    if cache is not None:
+        chunk = cache.chunk
+        packer = cache.packer
+    else:
+        chunk = chunk_rows or min(_auto_chunk_rows(cols), max(n_rows, 1))
+        # static shapes: round the chunk up so it splits evenly across devices
+        chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
+        packer = _ChunkPacker(cols, chunk)
     local_n = chunk // n_dev if mesh is not None else chunk
 
     def step(values, narrow_i, narrow_f, masks, codes, row_valid):
@@ -313,37 +466,54 @@ def run_scan(
             offset += size
         return jax.tree.unflatten(jax.tree.structure(shapes), leaves)
 
-    if mesh is not None:
-        inner = jax.shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(
-                P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
-                P(None, ROW_AXIS), P(None, ROW_AXIS),
-                P(ROW_AXIS),
-            ),
-            out_specs=P(),
-            check_vma=False,
-        )
+    # reuse the traced program across repeated runs over a persisted table
+    prog_key = None
+    if cache is not None and all(op.cache_key is not None for op in ops):
+        try:
+            prog_key = (tuple(op.cache_key for op in ops), chunk)
+            hash(prog_key)
+        except TypeError:
+            prog_key = None
+    cached_prog = cache.get_program(prog_key) if prog_key is not None else None
 
-        def flat_outer(values, narrow_i, narrow_f, masks, codes, row_valid):
-            partials = inner(values, narrow_i, narrow_f, masks, codes, row_valid)
-            leaves = jax.tree.leaves(partials)
-            return jnp.concatenate(
-                [jnp.ravel(leaf).astype(jnp.float64) for leaf in leaves]
+    if cached_prog is not None:
+        step_fn, shapes0 = cached_prog
+        shape_fn = None
+        SCAN_STATS.programs_reused += 1
+    else:
+        shapes0 = None
+        SCAN_STATS.programs_built += 1
+        if mesh is not None:
+            inner = jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(
+                    P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
+                    P(None, ROW_AXIS), P(None, ROW_AXIS),
+                    P(ROW_AXIS),
+                ),
+                out_specs=P(),
+                check_vma=False,
             )
 
-        step_fn = jax.jit(flat_outer)
-        shape_fn = inner
-    else:
-        step_fn = jax.jit(step_flat)
-        shape_fn = step
+            def flat_outer(values, narrow_i, narrow_f, masks, codes, row_valid):
+                partials = inner(values, narrow_i, narrow_f, masks, codes, row_valid)
+                leaves = jax.tree.leaves(partials)
+                return jnp.concatenate(
+                    [jnp.ravel(leaf).astype(jnp.float64) for leaf in leaves]
+                )
+
+            step_fn = jax.jit(flat_outer)
+            shape_fn = inner
+        else:
+            step_fn = jax.jit(step_flat)
+            shape_fn = step
 
     SCAN_STATS.scan_passes += 1
     SCAN_STATS.rows_scanned += n_rows
 
     merged = None
-    shapes = None
+    shapes = shapes0
     n_chunks = max(1, (n_rows + chunk - 1) // chunk)
 
     def drain(device_result):
@@ -389,16 +559,28 @@ def run_scan(
     t_start = _time.time()
     in_flight = []
     window = 3
-    for ci in range(n_chunks):
-        start = ci * chunk
-        stop = min(start + chunk, n_rows)
-        args = packer.pack(start, stop)
-        SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
-        if shapes is None:
-            shapes = jax.eval_shape(shape_fn, *args)
-        in_flight.append(step_fn(*put(args)))
-        if len(in_flight) >= window:
-            drain(in_flight.pop(0))
+    if cache is not None:
+        SCAN_STATS.resident_passes += 1
+        SCAN_STATS.bytes_resident += cache.nbytes
+        for args in cache.device_chunks:
+            if shapes is None:
+                shapes = jax.eval_shape(shape_fn, *args)
+                if prog_key is not None:
+                    cache.put_program(prog_key, (step_fn, shapes))
+            in_flight.append(step_fn(*args))
+            if len(in_flight) >= window:
+                drain(in_flight.pop(0))
+    else:
+        for ci in range(n_chunks):
+            start = ci * chunk
+            stop = min(start + chunk, n_rows)
+            args = packer.pack(start, stop)
+            SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
+            if shapes is None:
+                shapes = jax.eval_shape(shape_fn, *args)
+            in_flight.append(step_fn(*put(args)))
+            if len(in_flight) >= window:
+                drain(in_flight.pop(0))
     for device_result in in_flight:
         drain(device_result)
     SCAN_STATS.scan_seconds += _time.time() - t_start

@@ -168,6 +168,37 @@ class ColumnProfiler:
             save_or_append_results_with_key=save_in_metrics_repository_using_key,
         )
 
+        # multi-pass workload: keep the table device-resident across passes
+        # (the analogue of the reference caching the frequency/grouped data,
+        # AnalysisRunner.scala:493-497) — on the slow host->device link this
+        # turns passes 2..3 from transfer-bound into compute-bound
+        auto_persisted = []
+        if not data.is_persisted:
+            try:
+                data.persist()
+                auto_persisted.append(data)
+            except Exception:  # noqa: BLE001 — budget MemoryError, but also
+                # runtime RESOURCE_EXHAUSTED from device_put (fragmentation,
+                # other residents): persistence is an optimization, never a
+                # reason to fail the profile — fall back to streaming
+                data.unpersist()
+
+        try:
+            return ColumnProfiler._profile_passes(
+                data, relevant, predefined_types, print_status_updates,
+                low_cardinality_histogram_threshold, kll_profiling,
+                kll_parameters, run_kwargs, auto_persisted,
+            )
+        finally:
+            for t in auto_persisted:
+                t.unpersist()
+
+    @staticmethod
+    def _profile_passes(
+        data, relevant, predefined_types, print_status_updates,
+        low_cardinality_histogram_threshold, kll_profiling,
+        kll_parameters, run_kwargs, auto_persisted,
+    ) -> ColumnProfiles:
         # -- pass 1: generic statistics (ColumnProfiler.scala:122-139) ------
         if print_status_updates:
             print("### PROFILING: Computing generic column statistics in pass (1/3)...")
@@ -245,6 +276,12 @@ class ColumnProfiler:
             ]
             if kll_profiling:
                 numeric_analyzers.append(KLLSketch(name, kll_parameters))
+        if casted is not data and numeric_analyzers and not casted.is_persisted:
+            try:
+                casted.persist()
+                auto_persisted.append(casted)
+            except Exception:  # noqa: BLE001 — see pass-1 persist comment
+                casted.unpersist()
         ctx2 = (
             AnalysisRunner.do_analysis_run(casted, numeric_analyzers, **run_kwargs)
             if numeric_analyzers

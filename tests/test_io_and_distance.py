@@ -112,3 +112,25 @@ def test_categorical_distance():
     # robust correction subtracts the KS small-sample term
     robust = categorical_distance(a, c)
     assert robust < d
+
+
+def test_from_arrow_valid_nan_is_null():
+    """Arrow distinguishes null from NaN; the engine folds both into the
+    null mask (from_pandas convention) so valid NaNs never become 0.0
+    values corrupting Sum/Mean/Min/Max (advisor finding r1)."""
+    pa = pytest.importorskip("pyarrow")
+    from deequ_tpu.data.io import from_arrow
+
+    arrow = pa.table({"x": pa.array([1.0, float("nan"), None, 4.0])})
+    table = from_arrow(arrow)
+    col = table["x"]
+    assert list(col.mask) == [True, False, False, True]
+    # masked slots are zeroed, never NaN
+    assert np.all(np.isfinite(col.values))
+
+    from deequ_tpu.analyzers import Mean, Sum
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+
+    ctx = AnalysisRunner.do_analysis_run(table, [Sum("x"), Mean("x")])
+    assert ctx.metric_map[Sum("x")].value.get() == 5.0
+    assert ctx.metric_map[Mean("x")].value.get() == 2.5

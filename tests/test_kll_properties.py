@@ -109,3 +109,17 @@ def test_empty_sketch():
     assert np.isnan(sketch.quantile(0.5))
     assert sketch.rank(10.0) == 0
     assert sketch.count == 0
+
+
+def test_capacity_invariant_after_level_growth():
+    """Appending a new top level shrinks lower levels' depth-based
+    capacities; _compress must re-walk so every buffer ends within
+    capacity (QuantileNonSample invariant; advisor finding r1)."""
+    rng = np.random.default_rng(3)
+    sketch = KLLSketchState(sketch_size=64)
+    for _ in range(40):
+        sketch.update_batch(rng.normal(size=500))
+        for level in range(len(sketch.compactors)):
+            assert len(sketch.compactors[level]) <= sketch._capacity(level), (
+                level, len(sketch.compactors[level]), sketch._capacity(level)
+            )

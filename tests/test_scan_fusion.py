@@ -89,3 +89,89 @@ def test_precondition_failures_do_not_trigger_passes(df_with_numeric_values):
     ctx = AnalysisRunner.do_analysis_run(df_with_numeric_values, analyzers)
     assert all(m.value.is_failure for m in ctx.all_metrics())
     assert SCAN_STATS.scan_passes == 0
+
+
+def test_persisted_table_scans_from_hbm():
+    """persist() ships the table once; subsequent scans move zero host
+    bytes and produce identical metrics (the df.persist() analogue)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    rng = np.random.default_rng(11)
+    n = 4096
+    mask = np.ones(n, dtype=np.bool_)
+    mask[rng.integers(0, n, 40)] = False
+    table = ColumnarTable([
+        Column("a", DType.FRACTIONAL, values=rng.normal(5.0, 2.0, n), mask=mask),
+        Column("b", DType.INTEGRAL, values=rng.integers(0, 1000, n)),
+    ])
+    analyzers = [
+        Size(), Completeness("a"), Mean("a"), StandardDeviation("a"),
+        Minimum("b"), Maximum("b"), Sum("b"),
+    ]
+
+    streamed = AnalysisRunner.do_analysis_run(table, analyzers)
+
+    table.persist()
+    assert table.is_persisted
+    SCAN_STATS.reset()
+    resident = AnalysisRunner.do_analysis_run(table, analyzers)
+    assert SCAN_STATS.scan_passes == 1
+    assert SCAN_STATS.resident_passes == 1
+    assert SCAN_STATS.bytes_packed == 0  # nothing re-shipped
+    table.unpersist()
+    assert not table.is_persisted
+
+    for a in analyzers:
+        va = streamed.metric_map[a].value.get()
+        vb = resident.metric_map[a].value.get()
+        assert va == vb or abs(va - vb) < 1e-12, (a, va, vb)
+
+
+def test_profiler_persists_across_passes():
+    """The 3-pass profiler auto-persists: passes 2..N read from HBM."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.profiles.profiler import ColumnProfiler
+
+    rng = np.random.default_rng(13)
+    n = 2048
+    table = ColumnarTable([
+        Column("x", DType.FRACTIONAL, values=rng.normal(0.0, 1.0, n)),
+        Column("y", DType.INTEGRAL, values=rng.integers(0, 50, n)),
+    ])
+    SCAN_STATS.reset()
+    profiles = ColumnProfiler.profile(table)
+    assert profiles.profiles["x"].completeness == 1.0
+    # pass 1 streams (persist transfer), pass 2 reads from HBM
+    assert SCAN_STATS.resident_passes >= 2
+    assert not table.is_persisted  # auto-persist cleaned up
+
+
+def test_repeated_runs_reuse_compiled_program():
+    """N identical runs over a persisted table -> 1 traced/compiled
+    program (the analogue of SparkMonitor job accounting guarding against
+    recompiles; SURVEY §4)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    rng = np.random.default_rng(17)
+    n = 1024
+    table = ColumnarTable([
+        Column("a", DType.FRACTIONAL, values=rng.normal(size=n)),
+        Column("b", DType.INTEGRAL, values=rng.integers(0, 9, n)),
+    ]).persist()
+    analyzers = [Size(), Mean("a"), Minimum("a"), Maximum("b"), Sum("b")]
+
+    SCAN_STATS.reset()
+    first = AnalysisRunner.do_analysis_run(table, analyzers)
+    for _ in range(3):
+        again = AnalysisRunner.do_analysis_run(table, analyzers)
+    assert SCAN_STATS.programs_built == 1
+    assert SCAN_STATS.programs_reused == 3
+    for a in analyzers:
+        assert first.metric_map[a].value.get() == again.metric_map[a].value.get()
+    table.unpersist()
