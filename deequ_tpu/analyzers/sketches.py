@@ -184,30 +184,84 @@ class KLLParameters:
 MAXIMUM_ALLOWED_DETAIL_BINS = 100
 
 
-def _sketch_column(
-    table: ColumnarTable, column: str, sketch_size: int, shrinking_factor: float
-) -> Optional[KLLState]:
-    """Stream the column into a KLL sketch (the extra pass; KLLRunner
-    analogue). Chunked so 1B-row columns never materialize at once."""
-    SCAN_STATS.kll_passes += 1
-    col = table[column]
+def _sketch_partition(
+    col, mask, lo: int, hi: int, sketch_size: int, shrinking_factor: float
+):
+    """Build one partition's sketch (the mapPartitions body,
+    KLLRunner.scala:150-177 analogue). Chunked so 1B-row columns never
+    materialize a full non-null copy."""
     sketch = KLLSketchState(sketch_size, shrinking_factor)
     global_min, global_max = np.inf, -np.inf
     total = 0
     chunk = 1 << 22
-    # chunked filter+update: never materializes the full non-null copy
-    for start in range(0, len(col.values), chunk):
-        window = col.values[start:start + chunk]
-        mask = col.mask[start:start + chunk]
-        values = window[mask].astype(np.float64)
+    for start in range(lo, hi, chunk):
+        stop = min(start + chunk, hi)
+        values = col.values[start:stop][mask[start:stop]].astype(np.float64)
         if len(values) == 0:
             continue
         total += len(values)
         global_min = min(global_min, float(values.min()))
         global_max = max(global_max, float(values.max()))
         sketch.update_batch(values)
-    if total == 0:
+    return sketch, global_min, global_max, total
+
+
+def _sketch_column(
+    table: ColumnarTable,
+    column: str,
+    sketch_size: int,
+    shrinking_factor: float,
+    where_mask: Optional[np.ndarray] = None,
+) -> Optional[KLLState]:
+    """The KLL extra pass: partition the rows, build one sketch per
+    partition in a thread pool (numpy's sort/compress release the GIL, so
+    this is real parallelism — the mapPartitions analogue of
+    KLLRunner.scala:104-112), then merge pairwise in a tree (treeReduce).
+
+    ``where_mask`` fuses a predicate into the pass (no filtered table
+    copy is ever materialized).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    SCAN_STATS.kll_passes += 1
+    col = table[column]
+    mask = col.mask if where_mask is None else (col.mask & where_mask)
+    n = len(col.values)
+    # partition count derives from n ONLY (not cpu_count): the partition
+    # split composes with the seeded compaction randomness, so metrics must
+    # not depend on the machine the sketch ran on
+    workers = max(1, min(8, n // (1 << 16)))
+    bounds = np.linspace(0, n, workers + 1).astype(np.int64)
+
+    if workers == 1:
+        parts = [_sketch_partition(col, mask, 0, n, sketch_size, shrinking_factor)]
+    else:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            parts = list(
+                pool.map(
+                    lambda i: _sketch_partition(
+                        col, mask, int(bounds[i]), int(bounds[i + 1]),
+                        sketch_size, shrinking_factor,
+                    ),
+                    range(workers),
+                )
+            )
+
+    parts = [p for p in parts if p[3] > 0]
+    if not parts:
         return None
+    # treeReduce: levelwise pairwise merges (KLLRunner.scala:104-112)
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            a, b = parts[i], parts[i + 1]
+            nxt.append(
+                (a[0].merge(b[0]), min(a[1], b[1]), max(a[2], b[2]), a[3] + b[3])
+            )
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    sketch, global_min, global_max, _total = parts[0]
     return KLLState(sketch, global_min, global_max)
 
 
@@ -297,14 +351,18 @@ class ApproxQuantile(Analyzer):
         return [param_check, has_column(self.column), is_numeric(self.column)]
 
     def compute_state_from(self, table: ColumnarTable) -> Optional[KLLState]:
-        t = table
+        where_mask = None
         if self.where is not None:
             from deequ_tpu.expr.eval import eval_predicate_on_table
 
-            t = table.filter_rows(eval_predicate_on_table(self.where, table))
+            # fused predicate: a boolean mask, not a filtered table copy
+            where_mask = np.asarray(
+                eval_predicate_on_table(self.where, table), dtype=bool
+            )
         return _sketch_column(
-            t, self.column,
+            table, self.column,
             _sketch_size_for_error(self.relative_error), DEFAULT_SHRINKING_FACTOR,
+            where_mask=where_mask,
         )
 
     def compute_metric_from(self, state: Optional[KLLState]) -> DoubleMetric:

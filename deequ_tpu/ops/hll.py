@@ -210,13 +210,60 @@ def registers_from_hashes(hashes, valid, p: int, xp):
     return xp.maximum(regs, 0)  # untouched segments fill with INT_MIN
 
 
+def _sigma(x: float) -> float:
+    """Ertl's sigma: sum for the zero-register (small-range) correction."""
+    if x == 1.0:
+        return float("inf")
+    y = 1.0
+    z = x
+    while True:
+        x = x * x
+        z_prev = z
+        z = z + x * y
+        y = y + y
+        if z == z_prev:
+            return z
+
+
+def _tau(x: float) -> float:
+    """Ertl's tau: sum for the saturated-register (large-range) correction."""
+    if x == 0.0 or x == 1.0:
+        return 0.0
+    y = 1.0
+    z = 1.0 - x
+    while True:
+        x = math.sqrt(x)
+        z_prev = z
+        y = 0.5 * y
+        z = z - (1.0 - x) ** 2 * y
+        if z == z_prev:
+            return z / 3.0
+
+
 def estimate_cardinality(registers: np.ndarray) -> float:
-    """HLL estimate with linear counting for the small range."""
-    registers = np.asarray(registers, dtype=np.float64)
+    """Cardinality from an HLL register file via Ertl's improved estimator
+    ("New cardinality estimation algorithms for HyperLogLog sketches",
+    2017, public algorithm): a single closed-form estimate from the
+    register-value histogram with sigma/tau corrections for the zero- and
+    saturated-register tails.
+
+    Replaces the classic raw-estimate + linear-counting switch whose
+    uncorrected band at 2.5m-5m the reference patches with Spark's
+    empirical bias tables (StatefulHyperloglogPlus.scala:210-297). Ertl's
+    estimator is table-free AND unbiased across the whole range — no
+    copied constants, tighter error than interpolated bias correction.
+    """
+    registers = np.asarray(registers)
     m = len(registers)
-    alpha = 0.7213 / (1.0 + 1.079 / m)
-    raw = alpha * m * m / np.sum(np.exp2(-registers))
-    zeros = int((registers == 0).sum())
-    if raw <= 2.5 * m and zeros > 0:
-        return m * math.log(m / zeros)
-    return float(raw)
+    p = int(round(math.log2(m)))
+    q = 64 - p  # ranks are capped at q + 1 (registers_from_hashes)
+    counts = np.bincount(
+        registers.astype(np.int64), minlength=q + 2
+    ).astype(np.float64)
+    alpha_inf = 1.0 / (2.0 * math.log(2.0))
+    # sum_{k=1..q} C[k] * 2^{-k}, accumulated small-to-large for accuracy
+    z = m * _tau(1.0 - counts[q + 1] / m)
+    for k in range(q, 0, -1):
+        z = 0.5 * (z + counts[k])
+    z = z + m * _sigma(counts[0] / m)
+    return float(alpha_inf * m * m / z)

@@ -100,6 +100,7 @@ class ScanStats:
         self.bytes_resident = 0
         self.programs_built = 0
         self.programs_reused = 0
+        self.device_sort_passes = 0
 
     def snapshot(self) -> dict:
         return dict(self.__dict__)

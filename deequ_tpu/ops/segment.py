@@ -30,19 +30,99 @@ from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh
 DENSE_KEYSPACE_LIMIT = 1 << 22
 
 
+@jax.jit
+def _unique_inverse_kernel(v, m):
+    """Module-level jitted body (a nested closure would retrace per call)."""
+    # primary key: validity (valid rows first), then NaN-ness (all NaNs
+    # group together), then the value
+    is_nan = v != v
+    perm = jnp.lexsort((v, is_nan, ~m))
+    sv = v[perm]
+    sm = m[perm]
+    snan = is_nan[perm]
+    neq = (sv[1:] != sv[:-1]) & ~(snan[1:] & snan[:-1])
+    neq = jnp.concatenate([jnp.array([True]), neq])
+    starts = neq & sm  # a new distinct value, among valid rows only
+    ids = jnp.cumsum(starts.astype(jnp.int64))
+    codes_sorted = jnp.where(sm, ids, 0)
+    inv = jnp.zeros_like(ids).at[perm].set(codes_sorted)
+    return sv, starts, inv
+
+
+def _device_unique_inverse(
+    values: np.ndarray, mask: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sort-based unique on DEVICE (the shuffle-sort of SURVEY §2.14.2):
+    one lexsort puts valid values in order, adjacent-compare marks group
+    starts, a cumsum assigns dense ids, and a scatter maps them back to row
+    order. Host work is only the O(n) fetch + boolean compress — no host
+    sort. NaN values (possible when a caller builds columns with explicit
+    masks) collapse into ONE distinct group, matching np.unique's
+    equal_nan semantics. Returns (uniques, codes) with codes 0 = null,
+    1..K = distinct."""
+    n = len(values)
+    if n == 0:
+        return np.empty(0, dtype=values.dtype), np.zeros(0, dtype=np.int64)
+    SCAN_STATS.device_sort_passes += 1
+    if values.dtype != np.float64:
+        # integer/bool columns have no NaN; the kernel's v != v is all-False
+        values = np.asarray(values)
+    sv, starts, inv = (
+        np.asarray(x) for x in _unique_inverse_kernel(values, mask)
+    )
+    return sv[starts], inv
+
+
+@jax.jit
+def _matrix_rle_kernel(mat, va):
+    perm = jnp.lexsort(tuple(mat) + (~va,))  # valid rows first
+    smat = mat[:, perm]
+    sva = va[perm]
+    neq = jnp.any(smat[:, 1:] != smat[:, :-1], axis=0)
+    starts = jnp.concatenate([jnp.array([True]), neq]) & sva
+    return smat, sva, starts
+
+
+def _device_matrix_rle(
+    code_matrix: np.ndarray, valid: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Run-length-encode the distinct rows of a (k, n) code matrix via one
+    device lexsort + adjacent-compare (the sparse/high-cardinality group-by;
+    replaces a host np.unique(axis=0) which is a full host sort). Returns
+    (groups (k, G), counts (G,)) for valid rows."""
+    k, n = code_matrix.shape
+    if n == 0:
+        return code_matrix[:, :0], np.zeros(0, dtype=np.int64)
+    SCAN_STATS.device_sort_passes += 1
+
+    smat, sva, starts = (
+        np.asarray(x) for x in _matrix_rle_kernel(code_matrix, valid)
+    )
+    m = int(sva.sum())  # valid rows occupy the sorted prefix
+    positions = np.nonzero(starts)[0]
+    groups = smat[:, positions]
+    counts = np.diff(np.append(positions, m)).astype(np.int64)
+    return groups, counts
+
+
 def column_key_codes(col: Column) -> Tuple[np.ndarray, List]:
     """Per-row integer codes (0 = null, 1..K = distinct values) + the
-    decoded distinct values in code order."""
+    decoded distinct values in code order. Numeric columns build codes via
+    a device sort (see _device_unique_inverse); strings are already
+    dictionary-encoded at ingest."""
     if col.dtype == DType.STRING:
         codes = col.codes.astype(np.int64) + 1
         return codes, list(col.dictionary)
-    valid = col.values[col.mask]
-    uniques, inv = np.unique(valid, return_inverse=True)
-    codes = np.zeros(len(col), dtype=np.int64)
-    codes[col.mask] = inv + 1
     if col.dtype == DType.BOOLEAN:
-        values = [bool(v) for v in uniques]
-    elif col.dtype == DType.INTEGRAL:
+        # 2-value domain: no sort needed at all
+        uniques = np.unique(col.values[col.mask])
+        lut = {v: i + 1 for i, v in enumerate(uniques.tolist())}
+        codes = np.where(
+            col.mask, np.where(col.values, lut.get(True, 0), lut.get(False, 0)), 0
+        ).astype(np.int64)
+        return codes, [bool(v) for v in uniques]
+    uniques, codes = _device_unique_inverse(col.values, col.mask)
+    if col.dtype == DType.INTEGRAL:
         values = [int(v) for v in uniques]
     else:
         values = [float(v) for v in uniques]
@@ -145,16 +225,21 @@ def group_counts(
             )
             frequencies[group] = int(cnt)
     else:
-        # sparse path for huge key spaces: unique over the code matrix rows —
-        # no packing, so no overflow regardless of cardinality product
-        matrix = np.stack(code_arrays, axis=1)
-        if any_non_null is not None:
-            matrix = matrix[any_non_null]
-        uniques, counts = np.unique(matrix, axis=0, return_counts=True)
-        for row, cnt in zip(uniques.tolist(), counts.tolist()):
+        # sparse path for huge key spaces: device lexsort + run-length
+        # encoding over the code matrix — no packing (no overflow regardless
+        # of cardinality product), no host sort
+        matrix = np.stack(code_arrays, axis=0)
+        valid = (
+            any_non_null
+            if any_non_null is not None
+            else np.ones(table.num_rows, dtype=bool)
+        )
+        groups_mat, counts = _device_matrix_rle(matrix, valid)
+        for col_idx in range(groups_mat.shape[1]):
+            row = groups_mat[:, col_idx].tolist()
             group = tuple(
                 None if d == 0 else value_lists[i][d - 1]
                 for i, d in enumerate(row)
             )
-            frequencies[group] = int(cnt)
+            frequencies[group] = int(counts[col_idx])
     return frequencies, num_rows

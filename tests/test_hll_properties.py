@@ -77,3 +77,40 @@ def test_host_device_hash_consistency():
     assert host.tolist() == device.tolist()
     # -0.0 and +0.0 hash identically (canonicalization)
     assert host[0] == host[1]
+
+
+def test_ertl_estimator_accuracy_across_range():
+    """Relative error holds ~1.3/sqrt(m) across 100..1M cardinalities,
+    including the classic 2.5m-5m band the raw+linear-counting estimator
+    gets wrong without bias tables (VERDICT r1 #6; reference
+    StatefulHyperloglogPlus.scala:210-257)."""
+    from deequ_tpu.ops import hll
+
+    p = 9
+    m = 1 << p
+    bound = 1.3 / np.sqrt(m)
+
+    def estimate(n, seed):
+        rng = np.random.default_rng(seed)
+        vals = np.unique(rng.integers(0, 1 << 62, n, dtype=np.uint64))
+        h = hll.splitmix64(vals, np)
+        regs = hll.registers_from_hashes(
+            h, np.ones(len(h), dtype=bool), p, np
+        )
+        return hll.estimate_cardinality(np.asarray(regs))
+
+    # mid band (2.5m..5m = 1280..2560 at p=9) — the regression target —
+    # holds the tight bound; extremes allow 1.5/sqrt(m) (per-trial noise
+    # at fixed seeds, not bias: the signed mean stays tight everywhere)
+    cases = {
+        100: (6, 1.5), 500: (6, 1.5),
+        1280: (8, 1.3), 1600: (8, 1.3), 2000: (8, 1.3), 2560: (8, 1.3),
+        5000: (6, 1.3), 50_000: (4, 1.5), 1_000_000: (6, 1.5),
+    }
+    for n, (trials, k) in cases.items():
+        errs = [(estimate(n, 1000 + s) - n) / n for s in range(trials)]
+        mean_abs = float(np.mean(np.abs(errs)))
+        signed = float(np.mean(errs))
+        assert mean_abs <= k / np.sqrt(m), (n, mean_abs, k)
+        # no systematic bias: signed mean well inside the error bound
+        assert abs(signed) <= bound, (n, signed, bound)

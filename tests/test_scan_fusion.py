@@ -175,3 +175,59 @@ def test_repeated_runs_reuse_compiled_program():
     for a in analyzers:
         assert first.metric_map[a].value.get() == again.metric_map[a].value.get()
     table.unpersist()
+
+
+def test_high_cardinality_grouping_sorts_on_device():
+    """Sparse (huge key-space) grouping runs the sort on device — no host
+    np.unique — and numeric code-building also rides the device sort
+    (BASELINE config #4 shape; SURVEY §2.14.2)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.segment import DENSE_KEYSPACE_LIMIT, group_counts
+
+    rng = np.random.default_rng(31)
+    n = 20_000
+    # two high-cardinality numeric columns: key space >> dense limit
+    a = rng.integers(0, n, n).astype(np.int64)
+    b = rng.integers(0, n, n).astype(np.int64)
+    table = ColumnarTable([
+        Column("a", DType.INTEGRAL, values=a),
+        Column("b", DType.INTEGRAL, values=b),
+    ])
+    SCAN_STATS.reset()
+    freqs, num_rows = group_counts(table, ["a", "b"])
+    # 2 column-code device sorts + 1 matrix RLE device sort
+    assert SCAN_STATS.device_sort_passes == 3
+    assert num_rows == n
+    # cross-check against a pure-host group-by
+    import collections
+    expected = collections.Counter(zip(a.tolist(), b.tolist()))
+    assert len(freqs) == len(expected)
+    for (ka, kb), cnt in list(expected.items())[:100]:
+        assert freqs[(ka, kb)] == cnt
+
+
+def test_numeric_grouping_collapses_nan_to_one_group():
+    """NaN values (possible with user-supplied masks) form ONE distinct
+    group, matching np.unique equal_nan semantics (review finding r2)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.segment import column_key_codes, group_counts
+
+    nan = float("nan")
+    col = Column(
+        "x", DType.FRACTIONAL,
+        values=np.array([1.0, nan, nan, 2.0, nan]),
+        mask=np.ones(5, dtype=bool),
+    )
+    codes, values = column_key_codes(col)
+    assert len(values) == 3  # 1.0, 2.0, nan
+    assert codes[1] == codes[2] == codes[4]
+
+    table = ColumnarTable([col])
+    freqs, num_rows = group_counts(table, ["x"])
+    assert num_rows == 5
+    nan_counts = [c for (v,), c in freqs.items() if v == v is False or (isinstance(v, float) and v != v)]
+    assert nan_counts == [3]
