@@ -162,14 +162,7 @@ class KLLState(State):
             min(self.global_min, other.global_min),
             max(self.global_max, other.global_max),
         )
-
-    def serialize(self) -> tuple:
-        return (self.sketch.serialize(), self.global_min, self.global_max)
-
-    @staticmethod
-    def deserialize(data: tuple) -> "KLLState":
-        sk, lo, hi = data
-        return KLLState(KLLSketchState.deserialize(sk), lo, hi)
+    # binary persistence lives in states/serde.py (_enc_kll/_dec_kll)
 
 
 @dataclass(frozen=True)

@@ -20,13 +20,16 @@ from deequ_tpu.analyzers.runner import AnalyzerContext
 
 class FileSystemMetricsRepository(MetricsRepository):
     def __init__(self, path: str):
-        self.path = path
+        from deequ_tpu.data.fs import filesystem_for, strip_scheme
+
+        self.path = strip_scheme(path)
+        self._fs = filesystem_for(path)
         self._lock = threading.Lock()
 
     def _read_all(self) -> List[AnalysisResult]:
-        if not os.path.exists(self.path):
+        if not self._fs.exists(self.path):
             return []
-        with open(self.path) as f:
+        with self._fs.open(self.path, "r") as f:
             text = f.read()
         if not text.strip():
             return []
@@ -35,8 +38,8 @@ class FileSystemMetricsRepository(MetricsRepository):
     def _write_all(self, results: List[AnalysisResult]) -> None:
         parent = os.path.dirname(self.path)
         if parent:
-            os.makedirs(parent, exist_ok=True)
-        with open(self.path, "w") as f:
+            self._fs.makedirs(parent)
+        with self._fs.open(self.path, "w") as f:
             f.write(serde.serialize(results))
 
     def save(self, result: AnalysisResult) -> None:

@@ -11,8 +11,6 @@ file per analyzer under a directory; local paths play the role of HDFS/S3).
 from __future__ import annotations
 
 import hashlib
-import os
-import pickle
 import threading
 from typing import Dict, Optional
 
@@ -56,30 +54,39 @@ class FileSystemStateProvider(StateLoader, StatePersister):
     (the analogue of HdfsStateProvider's MurmurHash3-keyed files,
     reference analyzers/StateProvider.scala:73-312).
 
-    Encoding: each state object defines its own compact serialization via
-    ``serialize()`` when available (sketches), otherwise the dataclass is
-    pickled. Both round-trip bit-exactly, which the state round-trip tests
-    assert for every analyzer type (SURVEY.md §4).
+    Encoding: explicit versioned per-state-type binary codecs
+    (states/serde.py, mirroring the per-type encodings of
+    StateProvider.scala:86-141) — NOT pickle, so state files are safe to
+    load from shared storage and stable across library versions. Golden
+    byte fixtures in tests pin the format.
     """
 
     def __init__(self, location: str):
-        self.location = location
-        os.makedirs(location, exist_ok=True)
+        from deequ_tpu.data.fs import filesystem_for, strip_scheme
+
+        self.location = strip_scheme(location)
+        self._fs = filesystem_for(location)
+        self._fs.makedirs(self.location)
 
     def _path(self, analyzer: Analyzer) -> str:
         identifier = hashlib.sha1(repr(analyzer).encode()).hexdigest()[:16]
-        return os.path.join(self.location, f"{identifier}.state")
+        return self._fs.join(self.location, f"{identifier}.state")
 
     def load(self, analyzer: Analyzer) -> Optional[State]:
+        from deequ_tpu.states.serde import deserialize_state
+
         path = self._path(analyzer)
-        if not os.path.exists(path):
+        if not self._fs.exists(path):
             return None
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        with self._fs.open(path, "rb") as f:
+            return deserialize_state(f.read())
 
     def persist(self, analyzer: Analyzer, state: State) -> None:
-        with open(self._path(analyzer), "wb") as f:
-            pickle.dump(state, f)
+        from deequ_tpu.states.serde import serialize_state
+
+        data = serialize_state(state)
+        with self._fs.open(self._path(analyzer), "wb") as f:
+            f.write(data)
 
 
 # backwards-friendly alias mirroring the reference's name

@@ -1,0 +1,270 @@
+"""Versioned binary encodings for analyzer states.
+
+The analogue of the reference's per-type state encodings
+(analyzers/StateProvider.scala:86-141: long / double / (long,long) /
+(double,long) / raw-bytes HLL words / serialized sketches), replacing
+pickle: states are durable checkpoint artifacts that must be safe to load
+from shared storage and stable across library versions.
+
+Layout: ``MAGIC(4) | VERSION(u16) | TYPE_TAG(u16) | payload``; all integers
+little-endian. Every stateful analyzer type has an explicit payload codec
+below; golden byte fixtures in tests/test_state_serde.py pin the format.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, Tuple, Type
+
+from deequ_tpu.analyzers.base import State
+
+MAGIC = b"DQTS"
+VERSION = 1
+
+_u16 = struct.Struct("<H")
+_i64 = struct.Struct("<q")
+_f64 = struct.Struct("<d")
+
+
+def _pack_str(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return _i64.pack(len(raw)) + raw
+
+
+def _unpack_str(buf: bytes, off: int) -> Tuple[str, int]:
+    (n,) = _i64.unpack_from(buf, off)
+    off += 8
+    return buf[off:off + n].decode("utf-8"), off + n
+
+
+# -- group-value cells (FrequenciesAndNumRows keys) -------------------------
+
+_CELL_NULL, _CELL_STR, _CELL_INT, _CELL_FLOAT, _CELL_BOOL = range(5)
+
+
+def _pack_cell(v) -> bytes:
+    import numpy as np
+
+    # normalize numpy scalars so device-derived group keys encode natively
+    if isinstance(v, np.bool_):
+        v = bool(v)
+    elif isinstance(v, np.integer):
+        v = int(v)
+    elif isinstance(v, np.floating):
+        v = float(v)
+    elif isinstance(v, np.str_):
+        v = str(v)
+    if v is None:
+        return bytes([_CELL_NULL])
+    if isinstance(v, bool):
+        return bytes([_CELL_BOOL, 1 if v else 0])
+    if isinstance(v, int):
+        return bytes([_CELL_INT]) + _i64.pack(v)
+    if isinstance(v, float):
+        return bytes([_CELL_FLOAT]) + _f64.pack(v)
+    if isinstance(v, str):
+        return bytes([_CELL_STR]) + _pack_str(v)
+    # stringifying would silently change the group key's type on reload
+    # (merges would then double-count groups) — refuse instead
+    raise TypeError(f"unsupported group-key cell type {type(v).__name__}")
+
+
+def _unpack_cell(buf: bytes, off: int):
+    tag = buf[off]
+    off += 1
+    if tag == _CELL_NULL:
+        return None, off
+    if tag == _CELL_BOOL:
+        return bool(buf[off]), off + 1
+    if tag == _CELL_INT:
+        (v,) = _i64.unpack_from(buf, off)
+        return v, off + 8
+    if tag == _CELL_FLOAT:
+        (v,) = _f64.unpack_from(buf, off)
+        return v, off + 8
+    if tag == _CELL_STR:
+        return _unpack_str(buf, off)
+    raise ValueError(f"unknown group-cell tag {tag}")
+
+
+# -- per-type codecs --------------------------------------------------------
+
+
+def _codec_scalars(cls, fields: str):
+    """Codec for flat dataclasses of i64 ('i') / f64 ('d') fields."""
+    fmt = struct.Struct("<" + fields)
+    names = [f for f in cls.__dataclass_fields__]
+
+    def enc(state) -> bytes:
+        return fmt.pack(*(getattr(state, n) for n in names))
+
+    def dec(buf: bytes):
+        return cls(*fmt.unpack(buf))
+
+    return enc, dec
+
+
+def _enc_hll(state) -> bytes:
+    regs = state.registers
+    return _i64.pack(len(regs)) + bytes(int(r) & 0xFF for r in regs)
+
+
+def _dec_hll(buf: bytes):
+    from deequ_tpu.analyzers.sketches import ApproxCountDistinctState
+
+    (n,) = _i64.unpack_from(buf, 0)
+    return ApproxCountDistinctState(tuple(buf[8:8 + n]))
+
+
+def _enc_kll(state) -> bytes:
+    """Compact sketch encoding (KLLSketchSerializer.scala:26-121 analogue)."""
+    sketch = state.sketch
+    out = [
+        _i64.pack(sketch.sketch_size),
+        _f64.pack(sketch.shrinking_factor),
+        _i64.pack(sketch.count),
+        _f64.pack(state.global_min),
+        _f64.pack(state.global_max),
+        _i64.pack(len(sketch.compactors)),
+    ]
+    for buf in sketch.compactors:
+        import numpy as np
+
+        arr = np.asarray(buf, dtype="<f8")
+        out.append(_i64.pack(len(arr)))
+        out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def _dec_kll(buf: bytes):
+    import numpy as np
+
+    from deequ_tpu.analyzers.sketches import KLLState
+    from deequ_tpu.ops.kll import KLLSketchState
+
+    off = 0
+    (sketch_size,) = _i64.unpack_from(buf, off); off += 8
+    (shrinking,) = _f64.unpack_from(buf, off); off += 8
+    (count,) = _i64.unpack_from(buf, off); off += 8
+    (gmin,) = _f64.unpack_from(buf, off); off += 8
+    (gmax,) = _f64.unpack_from(buf, off); off += 8
+    (n_levels,) = _i64.unpack_from(buf, off); off += 8
+    compactors = []
+    for _ in range(n_levels):
+        (n,) = _i64.unpack_from(buf, off); off += 8
+        compactors.append(
+            np.frombuffer(buf, dtype="<f8", count=n, offset=off).copy()
+        )
+        off += 8 * n
+    sketch = KLLSketchState(sketch_size, shrinking, compactors, count)
+    return KLLState(sketch, gmin, gmax)
+
+
+def _enc_freq(state) -> bytes:
+    out = [_i64.pack(len(state.columns))]
+    for c in state.columns:
+        out.append(_pack_str(c))
+    out.append(_i64.pack(state.num_rows))
+    out.append(_i64.pack(len(state.frequencies)))
+    for group, count in state.frequencies:
+        for cell in group:
+            out.append(_pack_cell(cell))
+        out.append(_i64.pack(count))
+    return b"".join(out)
+
+
+def _dec_freq(buf: bytes):
+    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
+
+    off = 0
+    (n_cols,) = _i64.unpack_from(buf, off); off += 8
+    columns = []
+    for _ in range(n_cols):
+        c, off = _unpack_str(buf, off)
+        columns.append(c)
+    (num_rows,) = _i64.unpack_from(buf, off); off += 8
+    (n_groups,) = _i64.unpack_from(buf, off); off += 8
+    freqs = {}
+    for _ in range(n_groups):
+        group = []
+        for _ in range(n_cols):
+            cell, off = _unpack_cell(buf, off)
+            group.append(cell)
+        (count,) = _i64.unpack_from(buf, off); off += 8
+        freqs[tuple(group)] = count
+    return FrequenciesAndNumRows.from_dict(columns, freqs, num_rows)
+
+
+def _registry() -> Dict[Type[State], Tuple[int, Callable, Callable]]:
+    from deequ_tpu.analyzers import grouping, sketches, states
+
+    reg: Dict[Type[State], Tuple[int, Callable, Callable]] = {}
+
+    def add(tag, cls, enc, dec):
+        reg[cls] = (tag, enc, dec)
+
+    add(1, states.NumMatches, *_codec_scalars(states.NumMatches, "q"))
+    add(2, states.NumMatchesAndCount,
+        *_codec_scalars(states.NumMatchesAndCount, "qq"))
+    add(3, states.MinState, *_codec_scalars(states.MinState, "d"))
+    add(4, states.MaxState, *_codec_scalars(states.MaxState, "d"))
+    add(5, states.MeanState, *_codec_scalars(states.MeanState, "dq"))
+    add(6, states.SumState, *_codec_scalars(states.SumState, "d"))
+    add(7, states.StandardDeviationState,
+        *_codec_scalars(states.StandardDeviationState, "ddd"))
+    add(8, states.CorrelationState,
+        *_codec_scalars(states.CorrelationState, "dddddd"))
+    add(9, states.DataTypeHistogram,
+        *_codec_scalars(states.DataTypeHistogram, "qqqqq"))
+    add(10, sketches.ApproxCountDistinctState, _enc_hll, _dec_hll)
+    add(11, sketches.KLLState, _enc_kll, _dec_kll)
+    add(12, grouping.FrequenciesAndNumRows, _enc_freq, _dec_freq)
+    return reg
+
+
+_REG = None
+_BY_TAG = None
+
+
+def _ensure_registry():
+    global _REG, _BY_TAG
+    if _REG is None:
+        _REG = _registry()
+        _BY_TAG = {tag: (cls, enc, dec) for cls, (tag, enc, dec) in _REG.items()}
+    return _REG, _BY_TAG
+
+
+def serialize_state(state: State) -> bytes:
+    """State -> versioned bytes. Raises TypeError for unknown state types."""
+    reg, _ = _ensure_registry()
+    entry = reg.get(type(state))
+    if entry is None:
+        raise TypeError(
+            f"no binary codec registered for state type {type(state).__name__}"
+        )
+    tag, enc, _dec = entry
+    return MAGIC + _u16.pack(VERSION) + _u16.pack(tag) + enc(state)
+
+
+def deserialize_state(data: bytes) -> State:
+    """Versioned bytes -> State. Validates magic + version."""
+    if data[:4] != MAGIC:
+        if data[:1] == b"\x80":  # pickle protocol header
+            raise ValueError(
+                "legacy pickle state file from a pre-1.0 snapshot; "
+                "recompute the state (or load it with that version) — "
+                "pickle states are no longer read for safety"
+            )
+        raise ValueError("not a deequ_tpu state file (bad magic)")
+    (version,) = _u16.unpack_from(data, 4)
+    if version > VERSION:
+        raise ValueError(
+            f"state file version {version} is newer than supported {VERSION}"
+        )
+    (tag,) = _u16.unpack_from(data, 6)
+    _, by_tag = _ensure_registry()
+    entry = by_tag.get(tag)
+    if entry is None:
+        raise ValueError(f"unknown state type tag {tag}")
+    _cls, _enc, dec = entry
+    return dec(data[8:])

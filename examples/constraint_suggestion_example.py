@@ -1,0 +1,41 @@
+"""Constraint suggestion with train/test evaluation (the analogue of
+examples/ConstraintSuggestionExample.scala): profile a dataset, suggest
+constraints per column, then evaluate the suggested checks on a held-out
+test split."""
+
+from deequ_tpu import ColumnarTable
+from deequ_tpu.suggestions import ConstraintSuggestionRunner, Rules
+
+
+def run():
+    data = ColumnarTable.from_pydict(
+        {
+            "productName": [f"thingy-{i % 7}" for i in range(200)],
+            "totalNumber": [float(i % 50 + 1) for i in range(200)],
+            "status": (["IN_TRANSIT"] * 120 + ["DELAYED"] * 60 + ["UNKNOWN"] * 20),
+            "valuable": [None if i % 4 else "true" for i in range(200)],
+        }
+    )
+
+    result = (
+        ConstraintSuggestionRunner.on_data(data)
+        .add_constraint_rules(Rules.DEFAULT)
+        .use_train_test_split_with_test_set_ratio(0.1, seed=0)
+        .run()
+    )
+
+    print("suggested constraints (with code):")
+    for column, suggestions in result.suggestions.items():
+        for s in suggestions:
+            print(f"  {column}: {s.description}")
+            print(f"    current: {s.current_value}")
+            print(f"    code:    {s.code_for_constraint}")
+
+    if result.verification_result is not None:
+        print(f"\nheld-out evaluation: {result.verification_result.status}")
+        print(result.evaluation_as_json())
+    return result
+
+
+if __name__ == "__main__":
+    run()

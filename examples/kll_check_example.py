@@ -1,0 +1,40 @@
+"""KLL-backed distribution check (the analogue of
+examples/KLLCheckExample.scala): assert properties of a column's bucketed
+distribution via ``kll_sketch_satisfies``."""
+
+import numpy as np
+
+from deequ_tpu import Check, CheckLevel, ColumnarTable, VerificationSuite
+from deequ_tpu.analyzers import KLLParameters
+from deequ_tpu.verification import VerificationResult
+
+
+def run():
+    rng = np.random.default_rng(1)
+    data = ColumnarTable.from_pydict(
+        {"numViews": np.clip(rng.normal(50, 20, 10_000), 0, 100).tolist()}
+    )
+
+    check = Check(CheckLevel.ERROR, "kll distribution checks").kll_sketch_satisfies(
+        "numViews",
+        lambda dist: (
+            # values span [0, 100] and the middle buckets carry most mass
+            dist.buckets[0].low_value >= 0.0
+            and dist.buckets[-1].high_value <= 100.0
+            and sum(b.count for b in dist.buckets) == 10_000
+        ),
+        kll_parameters=KLLParameters(
+            sketch_size=2048, shrinking_factor=0.64, number_of_buckets=10
+        ),
+    )
+
+    result = VerificationSuite().on_data(data).add_check(check).run()
+    print(f"status: {result.status}")
+    for row in VerificationResult.check_results_as_rows(result):
+        print(f"  {row['constraint']}: {row['constraint_status']}")
+    assert str(result.status).endswith("SUCCESS")
+    return result
+
+
+if __name__ == "__main__":
+    run()
