@@ -1,0 +1,204 @@
+"""BASELINE.md benchmark configs 1-5, runnable at scaled sizes.
+
+Each config prints one JSON line: {"config", "metric", "rows", "value",
+"unit", "wall_seconds", ...}. Row counts default to sizes the environment's
+~33MB/s host->device tunnel can move in minutes; pass --rows to scale up on
+real TPU hosts (GB/s loads). Config 2 is bench.py (the driver headline).
+
+Usage:
+    python benchmarks/run_configs.py --config 1
+    python benchmarks/run_configs.py --all
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(**kwargs):
+    print(json.dumps(kwargs), flush=True)
+    return kwargs
+
+
+def config1():
+    """VerificationSuite {Size, Completeness, Uniqueness} on titanic.csv."""
+    from deequ_tpu import Check, CheckLevel, VerificationSuite
+    from deequ_tpu.data.io import read_csv
+
+    path = "/root/reference/test-data/titanic.csv"
+    table = read_csv(path)
+    check = (
+        Check(CheckLevel.ERROR, "titanic integrity")
+        .has_size(lambda n: n == 891)
+        .is_complete("PassengerId")
+        .has_completeness("Age", lambda c: c > 0.7)
+        .has_uniqueness(("PassengerId",), lambda u: u == 1.0)
+    )
+    suite = VerificationSuite().on_data(table).add_check(check)
+    suite.run()  # warmup/compile
+    t0 = time.time()
+    result = suite.run()
+    wall = time.time() - t0
+    assert str(result.status).endswith("SUCCESS"), result.status
+    return _emit(
+        config=1, metric="titanic_verification_wall", rows=table.num_rows,
+        value=round(wall, 4), unit="seconds", wall_seconds=round(wall, 4),
+    )
+
+
+def config3(n_rows: int):
+    """Correlation + ApproxQuantile(KLL) over 50 numeric columns."""
+    from deequ_tpu.analyzers import ApproxQuantile, Correlation
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    n_cols = 50
+    rng = np.random.default_rng(42)
+    base = rng.normal(0, 1, n_rows)
+    cols = [
+        Column(
+            f"c{i}", DType.FRACTIONAL,
+            values=base * (0.5 + 0.01 * i) + rng.normal(0, 1, n_rows),
+        )
+        for i in range(n_cols)
+    ]
+    table = ColumnarTable(cols)
+    analyzers = [Correlation(f"c{2*i}", f"c{2*i+1}") for i in range(n_cols // 2)]
+    analyzers += [ApproxQuantile(f"c{i}", 0.5) for i in range(n_cols)]
+
+    try:
+        table.persist()
+    except MemoryError:
+        pass
+    AnalysisRunner.do_analysis_run(table.head(1024), [analyzers[0]])  # warm
+    t0 = time.time()
+    ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+    wall = time.time() - t0
+    failed = [a for a, m in ctx.metric_map.items() if m.value.is_failure]
+    assert not failed, failed[:3]
+    return _emit(
+        config=3, metric="corr_kll_50col_rows_per_sec", rows=n_rows,
+        value=round(n_rows / wall, 1), unit="rows/sec",
+        wall_seconds=round(wall, 3),
+    )
+
+
+def config4(n_rows: int):
+    """ApproxCountDistinct + Histogram + Uniqueness on high-cardinality
+    dictionary-encoded strings."""
+    from deequ_tpu.analyzers import ApproxCountDistinct, Histogram, Uniqueness
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    rng = np.random.default_rng(43)
+    cardinality = max(n_rows // 3, 1)
+    codes = rng.integers(0, cardinality, n_rows).astype(np.int32)
+    dictionary = np.array([f"id_{i:09d}" for i in range(cardinality)], dtype=object)
+    table = ColumnarTable(
+        [Column("key", DType.STRING, codes=codes, dictionary=dictionary)]
+    )
+    analyzers = [
+        ApproxCountDistinct("key"), Histogram("key"), Uniqueness(("key",)),
+    ]
+    t0 = time.time()
+    ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+    wall = time.time() - t0
+    failed = [a for a, m in ctx.metric_map.items() if m.value.is_failure]
+    assert not failed, failed[:3]
+    acd = ctx.metric_map[analyzers[0]].value.get()
+    distinct = len(np.unique(codes))
+    assert abs(acd - distinct) / distinct < 0.15, (acd, distinct)
+    return _emit(
+        config=4, metric="hll_histogram_highcard_rows_per_sec", rows=n_rows,
+        value=round(n_rows / wall, 1), unit="rows/sec",
+        wall_seconds=round(wall, 3),
+    )
+
+
+def config5(n_batches: int, batch_rows: int):
+    """Incremental state stream + anomaly detection over the repository
+    (BASELINE config #5 shape, scaled)."""
+    from deequ_tpu.analyzers import Mean, Size, StandardDeviation
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.anomaly import AnomalyDetector, OnlineNormalStrategy
+    from deequ_tpu.anomaly.history import DataPoint
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.repository import AnalysisResult, ResultKey
+    from deequ_tpu.repository.memory import InMemoryMetricsRepository
+    from deequ_tpu.states import InMemoryStateProvider
+
+    analyzers = [Size(), Mean("v"), StandardDeviation("v")]
+    repo = InMemoryMetricsRepository()
+    states = InMemoryStateProvider()
+    rng = np.random.default_rng(44)
+
+    t0 = time.time()
+    for b in range(n_batches):
+        batch = ColumnarTable(
+            [Column("v", DType.FRACTIONAL,
+                    values=rng.normal(100.0, 5.0, batch_rows))]
+        )
+        # merge into running states AND persist the merged result, so each
+        # batch updates dataset-level metrics without rescanning history
+        ctx = AnalysisRunner.do_analysis_run(
+            batch, analyzers, aggregate_with=states, save_states_with=states
+        )
+        repo.save(AnalysisResult(ResultKey(b, {"stream": "s1"}), ctx))
+    wall = time.time() - t0
+
+    # anomaly detection over the metric time series
+    series = repo.load().with_tag_values({"stream": "s1"}).get()
+    means = [
+        DataPoint(r.result_key.data_set_date, m.value.get())
+        for r in series
+        for a, m in r.analyzer_context.metric_map.items()
+        if a == Mean("v")
+    ]
+    detector = AnomalyDetector(OnlineNormalStrategy())
+    result = detector.detect_anomalies_in_history(means)
+    total = n_batches * batch_rows
+    return _emit(
+        config=5, metric="incremental_stream_rows_per_sec", rows=total,
+        value=round(total / wall, 1), unit="rows/sec",
+        wall_seconds=round(wall, 3), batches=n_batches,
+        anomalies=len(result.anomalies),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=None)
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--rows", type=int, default=None)
+    args = ap.parse_args()
+
+    runners = {
+        1: lambda: config1(),
+        3: lambda: config3(args.rows or 4_000_000),
+        4: lambda: config4(args.rows or 4_000_000),
+        5: lambda: config5(50, (args.rows or 10_000_000) // 50),
+    }
+    if args.all:
+        for k in sorted(runners):
+            runners[k]()
+        print("config 2 is the driver bench: python bench.py", file=sys.stderr)
+    elif args.config in runners:
+        runners[args.config]()
+    elif args.config == 2:
+        import bench
+
+        bench.main()
+    else:
+        ap.error("--config {1,2,3,4,5} or --all")
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,13 @@ class _ChunkPacker:
         self._mask_row = {n: i for i, n in enumerate(self.masked_names)}
         self.cols = cols
         self.chunk = chunk
+        # metadata-only view for trace closures: dtypes + string
+        # dictionaries, NOT the column arrays — a traced program held in a
+        # long-lived cache must not pin entire batches in host memory
+        self.col_dtype = {n: c.dtype for n, c in cols.items()}
+        self.col_dict = {
+            n: cols[n].dictionary for n in self.string_names
+        }
 
     def pack(self, start: int, stop: int):
         chunk = self.chunk
@@ -244,7 +251,6 @@ class _ChunkPacker:
         for i, name in enumerate(self.narrow_f32):
             sources[name] = narrow_f[i].astype(xp.float64)
         for name in self.numeric_names:
-            col = self.cols[name]
             data = sources[name]
             if name in self._mask_row:
                 mask = masks[self._mask_row[name]]
@@ -252,15 +258,57 @@ class _ChunkPacker:
                 mask = row_valid
             else:
                 mask = xp.ones(data.shape, dtype=bool)
-            if col.dtype == DType.BOOLEAN:
+            if self.col_dtype[name] == DType.BOOLEAN:
                 vals[name] = Val("bool", data != 0.0, mask)
             else:
                 vals[name] = Val("num", data, mask)
         for j, name in enumerate(self.string_names):
             vals[name] = Val(
-                "str", codes[j], None, dictionary=self.cols[name].dictionary
+                "str", codes[j], None, dictionary=self.col_dict[name]
             )
         return vals
+
+    def unpack_view(self) -> "_ChunkPacker":
+        """A copy safe to capture in long-lived trace closures: same unpack
+        metadata, no references to the source column arrays."""
+        view = _ChunkPacker.__new__(_ChunkPacker)
+        view.string_names = self.string_names
+        view.narrow_i32 = self.narrow_i32
+        view.narrow_f32 = self.narrow_f32
+        view.wide_names = self.wide_names
+        view.numeric_names = self.numeric_names
+        view.masked_names = self.masked_names
+        view._mask_row = self._mask_row
+        view.cols = None  # pack() is not available on a view
+        view.chunk = self.chunk
+        view.col_dtype = self.col_dtype
+        view.col_dict = self.col_dict
+        return view
+
+
+class _BoundedLRU:
+    """Tiny bounded LRU over a dict (insertion order = recency)."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._d: Dict[Any, Any] = {}
+
+    def get(self, key):
+        val = self._d.pop(key, None)
+        if val is not None:
+            self._d[key] = val  # re-insert: most-recently-used
+        return val
+
+    def put(self, key, val) -> None:
+        self._d[key] = val
+        while len(self._d) > self.cap:
+            self._d.pop(next(iter(self._d)))
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def clear(self) -> None:
+        self._d.clear()
 
 
 class DeviceTableCache:
@@ -289,19 +337,14 @@ class DeviceTableCache:
         # (op cache_keys, chunk) -> (step_fn, shapes): reused traced
         # programs, LRU-bounded so long-lived services with varied analyzer
         # sets don't accumulate executables without limit
-        self.programs: Dict[Any, Any] = {}
+        self.programs = _BoundedLRU(self.MAX_CACHED_PROGRAMS)
         _ACTIVE_CACHES.add(self)
 
     def get_program(self, key):
-        prog = self.programs.pop(key, None)
-        if prog is not None:
-            self.programs[key] = prog  # re-insert: most-recently-used
-        return prog
+        return self.programs.get(key)
 
     def put_program(self, key, prog) -> None:
-        self.programs[key] = prog
-        while len(self.programs) > self.MAX_CACHED_PROGRAMS:
-            self.programs.pop(next(iter(self.programs)))
+        self.programs.put(key, prog)
 
     def matches(self, mesh, needed_cols) -> bool:
         same_mesh = (
@@ -320,6 +363,18 @@ class DeviceTableCache:
 # footprint — e.g. the profiler holding both the raw and the numeric-cast
 # table — against the HBM budget, not just the newest table's size.
 _ACTIVE_CACHES: "weakref.WeakSet[DeviceTableCache]" = weakref.WeakSet()
+
+# Global traced-program cache for STREAMING runs over tables with identical
+# (analyzer set, packer layout, chunk, mesh) — the incremental-monitoring
+# hot path: the same suite runs on every arriving batch, and retracing a
+# wide fused program per batch costs more than scanning the batch. Only
+# table-INDEPENDENT programs are cacheable: ops over string columns bake
+# per-table dictionary lookup tables into the trace as constants
+# (PatternMatch regex LUT, length LUT, DataType classify LUT, string-code
+# resolution in predicates), so any string column disables the cache.
+# Entries hold only the jitted function (closing over a metadata-only
+# unpack view) + result shapes — never batch data.
+_GLOBAL_PROGRAMS = _BoundedLRU(64)
 
 
 def total_resident_bytes() -> int:
@@ -425,8 +480,12 @@ def run_scan(
         packer = _ChunkPacker(cols, chunk)
     local_n = chunk // n_dev if mesh is not None else chunk
 
+    # the trace closure captures a metadata-only view, never the column
+    # arrays — cached programs must not pin batches in host memory
+    unpacker = packer.unpack_view()
+
     def step(values, narrow_i, narrow_f, masks, codes, row_valid):
-        vals = packer.unpack_vals(
+        vals = unpacker.unpack_vals(
             values, narrow_i, narrow_f, masks, codes, jnp, row_valid
         )
         partials = tuple(op.update(vals, row_valid, jnp, local_n) for op in ops)
@@ -467,15 +526,39 @@ def run_scan(
             offset += size
         return jax.tree.unflatten(jax.tree.structure(shapes), leaves)
 
-    # reuse the traced program across repeated runs over a persisted table
+    # reuse the traced program across repeated runs: per-table cache for
+    # persisted tables; global cache for streaming same-schema batches
+    # (numeric-only — string columns bake table dictionaries into the trace)
     prog_key = None
-    if cache is not None and all(op.cache_key is not None for op in ops):
+    global_key = None
+    cached_prog = None
+    if all(op.cache_key is not None for op in ops):
         try:
             prog_key = (tuple(op.cache_key for op in ops), chunk)
             hash(prog_key)
         except TypeError:
             prog_key = None
-    cached_prog = cache.get_program(prog_key) if prog_key is not None else None
+    if cache is not None and prog_key is not None:
+        cached_prog = cache.get_program(prog_key)
+    elif (
+        cache is None
+        and prog_key is not None
+        and not packer.string_names
+    ):
+        layout = (
+            tuple(packer.wide_names),
+            tuple(packer.narrow_i32),
+            tuple(packer.narrow_f32),
+            tuple(packer.masked_names),
+            tuple((name, packer.cols[name].dtype) for name in packer.numeric_names),
+        )
+        mesh_key = (
+            (mesh.devices.shape, tuple(mesh.axis_names), tuple(mesh.devices.flat))
+            if mesh is not None
+            else None
+        )
+        global_key = (prog_key, layout, mesh_key)
+        cached_prog = _GLOBAL_PROGRAMS.get(global_key)
 
     if cached_prog is not None:
         step_fn, shapes0 = cached_prog
@@ -579,6 +662,8 @@ def run_scan(
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
             if shapes is None:
                 shapes = jax.eval_shape(shape_fn, *args)
+                if global_key is not None:
+                    _GLOBAL_PROGRAMS.put(global_key, (step_fn, shapes))
             in_flight.append(step_fn(*put(args)))
             if len(in_flight) >= window:
                 drain(in_flight.pop(0))

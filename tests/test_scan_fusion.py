@@ -231,3 +231,44 @@ def test_numeric_grouping_collapses_nan_to_one_group():
     assert num_rows == 5
     nan_counts = [c for (v,), c in freqs.items() if v == v is False or (isinstance(v, float) and v != v)]
     assert nan_counts == [3]
+
+
+def test_streaming_batches_reuse_global_program():
+    """Incremental monitoring: the same numeric suite over successive
+    same-schema batches traces ONCE (global program cache). String columns
+    disable the cache (their dictionary LUTs are trace constants)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    def batch(seed):
+        rng = np.random.default_rng(seed)
+        return ColumnarTable([
+            Column("v", DType.FRACTIONAL, values=rng.normal(size=512)),
+        ])
+
+    from deequ_tpu.ops.scan_engine import _GLOBAL_PROGRAMS
+
+    _GLOBAL_PROGRAMS.clear()  # module-level cache: isolate from other tests
+    analyzers = [Size(), Mean("v"), StandardDeviation("v"), Minimum("v")]
+    SCAN_STATS.reset()
+    results = []
+    for seed in range(4):
+        ctx = AnalysisRunner.do_analysis_run(batch(seed), analyzers)
+        results.append(ctx.metric_map[Mean("v")].value.get())
+    assert SCAN_STATS.programs_built == 1
+    assert SCAN_STATS.programs_reused == 3
+    # correctness: each batch got its OWN mean, not a cached value
+    expected = [float(np.random.default_rng(s).normal(size=512).mean())
+                for s in range(4)]
+    assert np.allclose(results, expected)
+
+    # string column -> per-table dictionaries -> no global reuse
+    SCAN_STATS.reset()
+    for seed in range(2):
+        rng = np.random.default_rng(seed)
+        t = ColumnarTable.from_pydict(
+            {"s": [f"v{i}" for i in rng.integers(0, 5, 64)]}
+        )
+        AnalysisRunner.do_analysis_run(t, [Completeness("s")])
+    assert SCAN_STATS.programs_built == 2
