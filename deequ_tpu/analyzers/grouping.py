@@ -108,12 +108,29 @@ class FrequencyBasedAnalyzer(Analyzer):
 
 class ScanShareableFrequencyBasedAnalyzer(FrequencyBasedAnalyzer):
     """Computes one double from the shared frequency table
-    (reference GroupingAnalyzers.scala:83-120)."""
+    (reference GroupingAnalyzers.scala:83-120).
+
+    All concrete subclasses are functions of the COUNT distribution only,
+    so when no state persistence is requested the planner computes them
+    from device-side count aggregates (ops/segment.py:CountStats) without
+    ever materializing the frequency table on host — the difference
+    between O(#groups) python decode and a handful of scalars for
+    high-cardinality groupings."""
 
     metric_name: str = ""
 
     def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
         raise NotImplementedError
+
+    def compute_from_count_stats(self, stats) -> float:
+        raise NotImplementedError
+
+    def metric_from_count_stats(self, stats) -> DoubleMetric:
+        try:
+            value = self.compute_from_count_stats(stats)
+        except Exception as e:  # noqa: BLE001
+            return self.to_failure_metric(e)
+        return metric_from_value(value, self.metric_name, self.instance, self.entity)
 
     def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> DoubleMetric:
         if state is None:
@@ -157,6 +174,11 @@ class Uniqueness(ScanShareableFrequencyBasedAnalyzer):
             return float("nan")
         return float((counts == 1).sum() / state.num_rows)
 
+    def compute_from_count_stats(self, stats) -> float:
+        if stats.num_rows == 0:
+            return float("nan")
+        return stats.singletons / stats.num_rows
+
 
 @dataclass(frozen=True)
 class UniqueValueRatio(ScanShareableFrequencyBasedAnalyzer):
@@ -183,6 +205,11 @@ class UniqueValueRatio(ScanShareableFrequencyBasedAnalyzer):
             return float("nan")
         return float((counts == 1).sum() / len(counts))
 
+    def compute_from_count_stats(self, stats) -> float:
+        if stats.num_groups == 0:
+            return float("nan")
+        return stats.singletons / stats.num_groups
+
 
 @dataclass(frozen=True)
 class Distinctness(ScanShareableFrequencyBasedAnalyzer):
@@ -207,6 +234,11 @@ class Distinctness(ScanShareableFrequencyBasedAnalyzer):
             return float("nan")
         return float(state.num_groups / state.num_rows)
 
+    def compute_from_count_stats(self, stats) -> float:
+        if stats.num_rows == 0:
+            return float("nan")
+        return stats.num_groups / stats.num_rows
+
 
 @dataclass(frozen=True)
 class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
@@ -228,6 +260,9 @@ class CountDistinct(ScanShareableFrequencyBasedAnalyzer):
 
     def compute_from_frequencies(self, state: FrequenciesAndNumRows) -> float:
         return float(state.num_groups)
+
+    def compute_from_count_stats(self, stats) -> float:
+        return float(stats.num_groups)
 
 
 @dataclass(frozen=True)
@@ -251,6 +286,11 @@ class Entropy(ScanShareableFrequencyBasedAnalyzer):
         p = counts / n
         nonzero = p > 0
         return float(-(p[nonzero] * np.log(p[nonzero])).sum())
+
+    def compute_from_count_stats(self, stats) -> float:
+        if stats.num_rows == 0:
+            return float("nan")
+        return stats.entropy
 
 
 @dataclass(frozen=True)

@@ -274,7 +274,31 @@ class AnalysisRunner:
         aggregate_with=None,
         save_states_with=None,
     ) -> AnalyzerContext:
-        from deequ_tpu.ops.segment import group_counts
+        from deequ_tpu.ops.segment import group_count_stats, group_counts
+
+        # count-stats fast path: when nobody needs the materialized
+        # frequency table (no state persistence/merge, and every analyzer
+        # is a pure function of the count distribution), the grouping runs
+        # entirely as device aggregates — group values never decode to a
+        # host dict. For high-cardinality groupings this removes the
+        # O(#groups) host materialization.
+        if (
+            aggregate_with is None
+            and save_states_with is None
+            and all(
+                hasattr(a, "metric_from_count_stats") for a in analyzers
+            )
+        ):
+            try:
+                stats = group_count_stats(data, grouping_columns)
+            except Exception as e:  # noqa: BLE001
+                wrapped = wrap_if_necessary(e)
+                return AnalyzerContext(
+                    {a: a.to_failure_metric(wrapped) for a in analyzers}
+                )
+            return AnalyzerContext(
+                {a: a.metric_from_count_stats(stats) for a in analyzers}
+            )
 
         try:
             freqs, num_rows = group_counts(data, grouping_columns)

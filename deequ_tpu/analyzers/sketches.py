@@ -320,6 +320,71 @@ def _sketch_size_for_error(relative_error: float) -> int:
     return max(256, int(2.3 / max(relative_error, 1e-6)))
 
 
+def _device_exact_quantiles(table, column: str, qs) -> Optional[tuple]:
+    """EXACT quantiles via a device sort over a persisted table's HBM
+    buffers — the TPU-first fast path for ApproxQuantile(s) when no
+    mergeable sketch state is needed.
+
+    The sketch exists to make quantiles mergeable across partitions and
+    incremental runs (KLLRunner.scala's whole reason to exist). When the
+    column is already device-resident and the caller needs only the metric,
+    a single XLA sort is both faster and exact — any relative_error bound
+    is trivially satisfied. Returns (values_for_qs, valid_count) or None
+    if the fast path doesn't apply.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cache = getattr(table, "_device_cache", None)
+    if cache is None or not cache.device_chunks:
+        return None
+    packer = cache.packer
+    if column in packer.wide_names:
+        src, row = "wide", packer.wide_names.index(column)
+    elif column in packer.narrow_i32:
+        src, row = "narrow_i", packer.narrow_i32.index(column)
+    elif column in packer.narrow_f32:
+        src, row = "narrow_f", packer.narrow_f32.index(column)
+    else:
+        return None  # string column
+    mask_row = packer._mask_row.get(column)
+
+    prog_key = ("exact_quantiles", column, tuple(qs), len(cache.device_chunks))
+    fn = cache.get_program(prog_key)
+    if fn is None:
+
+        def kernel(*chunks):
+            parts = []
+            masks_ = []
+            for (values, narrow_i, narrow_f, masks, codes, row_valid) in chunks:
+                buf = {"wide": values, "narrow_i": narrow_i,
+                       "narrow_f": narrow_f}[src][row]
+                parts.append(buf.astype(jnp.float64))
+                masks_.append(
+                    masks[mask_row] & row_valid
+                    if mask_row is not None
+                    else row_valid
+                )
+            v = jnp.concatenate(parts)
+            m = jnp.concatenate(masks_)
+            count = m.sum()
+            sv = jnp.sort(jnp.where(m, v, jnp.inf))
+            idx = jnp.clip(
+                jnp.round(jnp.asarray(qs) * jnp.maximum(count - 1, 0)),
+                0, jnp.maximum(count - 1, 0),
+            ).astype(jnp.int32)
+            return sv[idx], count
+
+        fn = jax.jit(lambda *chunks: kernel(*chunks))
+        cache.put_program(prog_key, fn)
+
+    values, count = fn(*[tuple(c) for c in cache.device_chunks])
+    count = int(count)
+    if count == 0:
+        return None
+    return np.asarray(values), count
+
+
 @dataclass(frozen=True)
 class ApproxQuantile(Analyzer):
     """Single approximate quantile (reference analyzers/ApproxQuantile.scala).
@@ -365,6 +430,30 @@ class ApproxQuantile(Analyzer):
             )
         value = state.sketch.quantile(self.quantile)
         return metric_from_value(value, "ApproxQuantile", self.column, Entity.COLUMN)
+
+    def calculate(self, table, aggregate_with=None, save_states_with=None):
+        # persisted table + no mergeable state needed -> exact device sort
+        # (see _device_exact_quantiles); otherwise the KLL sketch path
+        if (
+            aggregate_with is None
+            and save_states_with is None
+            and self.where is None
+        ):
+            from deequ_tpu.analyzers.base import find_first_failing
+
+            failing = find_first_failing(table.schema, self.preconditions())
+            if failing is not None:
+                return self.to_failure_metric(failing)
+            try:
+                fast = _device_exact_quantiles(table, self.column, (self.quantile,))
+            except Exception as e:  # noqa: BLE001
+                return self.to_failure_metric(wrap_if_necessary(e))
+            if fast is not None:
+                values, _count = fast
+                return metric_from_value(
+                    float(values[0]), "ApproxQuantile", self.column, Entity.COLUMN
+                )
+        return super().calculate(table, aggregate_with, save_states_with)
 
     def to_failure_metric(self, exception: Exception) -> DoubleMetric:
         return metric_from_failure(
@@ -417,6 +506,27 @@ class ApproxQuantiles(Analyzer):
         return KeyedDoubleMetric(
             Entity.COLUMN, "ApproxQuantiles", self.column, Success(values)
         )
+
+    def calculate(self, table, aggregate_with=None, save_states_with=None):
+        if aggregate_with is None and save_states_with is None:
+            from deequ_tpu.analyzers.base import find_first_failing
+
+            failing = find_first_failing(table.schema, self.preconditions())
+            if failing is not None:
+                return self.to_failure_metric(failing)
+            try:
+                fast = _device_exact_quantiles(table, self.column, self.quantiles)
+            except Exception as e:  # noqa: BLE001
+                return self.to_failure_metric(wrap_if_necessary(e))
+            if fast is not None:
+                values, _count = fast
+                keyed = {
+                    str(q): float(v) for q, v in zip(self.quantiles, values)
+                }
+                return KeyedDoubleMetric(
+                    Entity.COLUMN, "ApproxQuantiles", self.column, Success(keyed)
+                )
+        return super().calculate(table, aggregate_with, save_states_with)
 
     def to_failure_metric(self, exception: Exception) -> KeyedDoubleMetric:
         return KeyedDoubleMetric(

@@ -14,6 +14,7 @@ large to materialize as a dense count vector) we fall back to host
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -243,3 +244,85 @@ def group_counts(
             )
             frequencies[group] = int(counts[col_idx])
     return frequencies, num_rows
+
+
+@dataclass(frozen=True)
+class CountStats:
+    """Scalar aggregates of the group-count distribution — everything the
+    count-only grouping analyzers (Uniqueness, UniqueValueRatio,
+    Distinctness, CountDistinct, Entropy) need, WITHOUT materializing the
+    frequency table on host. For high-cardinality groupings (#groups ~ n)
+    this skips the O(n) group decode + dict build entirely."""
+
+    num_rows: int
+    num_groups: int
+    singletons: int
+    entropy: float
+
+
+def group_count_stats(
+    table: ColumnarTable,
+    columns: Sequence[str],
+    mesh=None,
+    require_any_non_null: bool = True,
+) -> CountStats:
+    """Count-distribution aggregates for a grouping, group values never
+    leaving the device (sparse path) / never decoded (dense path)."""
+    if mesh is None:
+        mesh = current_mesh()
+    SCAN_STATS.grouping_passes += 1
+    SCAN_STATS.rows_scanned += table.num_rows
+
+    code_arrays = []
+    radices = []
+    for name in columns:
+        codes, values = column_key_codes(table[name])
+        code_arrays.append(codes)
+        radices.append(len(values) + 1)
+
+    if require_any_non_null and len(columns) > 0:
+        any_non_null = np.zeros(table.num_rows, dtype=bool)
+        for codes in code_arrays:
+            any_non_null |= codes > 0
+        num_rows = int(any_non_null.sum())
+    else:
+        any_non_null = None
+        num_rows = table.num_rows
+
+    keyspace = 1
+    for radix in radices:
+        keyspace *= radix
+
+    if keyspace <= DENSE_KEYSPACE_LIMIT:
+        keys = np.zeros(table.num_rows, dtype=np.int64)
+        for codes, radix in zip(code_arrays, radices):
+            keys = keys * radix + codes
+        if any_non_null is not None:
+            keys = np.where(any_non_null, keys, -1)
+        counts = _device_bincount(keys, keyspace, mesh)
+        counts = counts[counts > 0]
+    else:
+        matrix = np.stack(code_arrays, axis=0)
+        valid = (
+            any_non_null
+            if any_non_null is not None
+            else np.ones(table.num_rows, dtype=bool)
+        )
+        SCAN_STATS.device_sort_passes += 1
+        _smat, sva, starts = _matrix_rle_kernel(matrix, valid)
+        # fetch ONLY the boolean vectors — the sorted group matrix stays on
+        # device (it is only needed when materializing the full table)
+        sva = np.asarray(sva)
+        starts = np.asarray(starts)
+        m = int(sva.sum())
+        positions = np.nonzero(starts)[0]
+        counts = np.diff(np.append(positions, m)).astype(np.int64)
+
+    num_groups = int(len(counts))
+    singletons = int((counts == 1).sum())
+    if num_rows > 0 and num_groups > 0:
+        p = counts.astype(np.float64) / num_rows
+        entropy = float(-(p * np.log(p)).sum())
+    else:
+        entropy = float("nan")
+    return CountStats(num_rows, num_groups, singletons, entropy)

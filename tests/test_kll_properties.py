@@ -171,3 +171,39 @@ def test_approx_quantile_where_fuses_mask():
     true = np.quantile(vals[flag > 0.5], 0.5)
     assert abs(est - true) < 1.0, (est, true)
     assert abs(ref - true) < 1.0, (ref, true)
+
+
+def test_persisted_table_gets_exact_device_quantiles():
+    """ApproxQuantile(s) on a persisted table run an exact device sort;
+    unpersisted or stateful runs keep the mergeable sketch path."""
+    from deequ_tpu.analyzers import ApproxQuantile, ApproxQuantiles
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(41)
+    n = 100_001
+    vals = rng.uniform(0, 1000, n)
+    mask = np.ones(n, dtype=bool)
+    mask[rng.integers(0, n, 500)] = False
+    table = ColumnarTable([
+        Column("v", DType.FRACTIONAL, values=vals, mask=mask),
+    ]).persist()
+
+    a1 = ApproxQuantile("v", 0.5)
+    a2 = ApproxQuantiles("v", (0.25, 0.5, 0.75))
+    ctx = AnalysisRunner.do_analysis_run(table, [a1, a2])
+    valid = vals[mask]
+    exact = float(np.sort(valid)[round(0.5 * (len(valid) - 1))])
+    assert ctx.metric_map[a1].value.get() == exact  # exact, not approximate
+    keyed = ctx.metric_map[a2].value.get()
+    for q in (0.25, 0.5, 0.75):
+        expect = float(np.sort(valid)[round(q * (len(valid) - 1))])
+        assert keyed[str(q)] == expect
+
+    # stateful run must produce a mergeable sketch state instead
+    sp = InMemoryStateProvider()
+    ctx2 = AnalysisRunner.do_analysis_run(table, [a1], save_states_with=sp)
+    assert sp.load(a1) is not None  # KLL state persisted
+    assert abs(ctx2.metric_map[a1].value.get() - exact) < 20.0
+    table.unpersist()

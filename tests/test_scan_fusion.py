@@ -272,3 +272,34 @@ def test_streaming_batches_reuse_global_program():
         )
         AnalysisRunner.do_analysis_run(t, [Completeness("s")])
     assert SCAN_STATS.programs_built == 2
+
+
+def test_count_stats_fast_path_matches_full_path():
+    """Without state persistence, grouping analyzers run from device count
+    aggregates; with a state provider they take the full frequency-table
+    path. Both agree."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import (
+        CountDistinct, Distinctness, Entropy, UniqueValueRatio, Uniqueness,
+    )
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(37)
+    n = 30_000
+    table = ColumnarTable([
+        Column("k", DType.INTEGRAL, values=rng.integers(0, n, n)),
+    ])
+    analyzers = [
+        Uniqueness(("k",)), UniqueValueRatio(("k",)), Distinctness(("k",)),
+        CountDistinct(("k",)), Entropy("k"),
+    ]
+    fast = AnalysisRunner.do_analysis_run(table, analyzers)
+    full = AnalysisRunner.do_analysis_run(
+        table, analyzers, save_states_with=InMemoryStateProvider()
+    )
+    for a in analyzers:
+        vf = fast.metric_map[a].value.get()
+        vz = full.metric_map[a].value.get()
+        assert abs(vf - vz) < 1e-12, (a, vf, vz)
