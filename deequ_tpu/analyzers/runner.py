@@ -281,13 +281,26 @@ class AnalysisRunner:
         # is a pure function of the count distribution), the grouping runs
         # entirely as device aggregates — group values never decode to a
         # host dict. For high-cardinality groupings this removes the
-        # O(#groups) host materialization.
+        # O(#groups) host materialization. Gated on an explicit override
+        # (not hasattr, which every subclass inherits): a subclass that only
+        # implements compute_from_frequencies falls back to the frequency
+        # table instead of having its NotImplementedError swallowed into a
+        # failure metric.
+        from deequ_tpu.analyzers.grouping import (
+            ScanShareableFrequencyBasedAnalyzer as _SSF,
+        )
+
+        def _has_count_stats(a) -> bool:
+            return (
+                isinstance(a, _SSF)
+                and type(a).compute_from_count_stats
+                is not _SSF.compute_from_count_stats
+            )
+
         if (
             aggregate_with is None
             and save_states_with is None
-            and all(
-                hasattr(a, "metric_from_count_stats") for a in analyzers
-            )
+            and all(_has_count_stats(a) for a in analyzers)
         ):
             try:
                 stats = group_count_stats(data, grouping_columns)

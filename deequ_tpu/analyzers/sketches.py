@@ -369,8 +369,14 @@ def _device_exact_quantiles(table, column: str, qs) -> Optional[tuple]:
             m = jnp.concatenate(masks_)
             count = m.sum()
             sv = jnp.sort(jnp.where(m, v, jnp.inf))
+            # SAME rank rule as the KLL sketch path (searchsorted-left over
+            # cumulative weights, KLLSketchState.quantile): on exact data
+            # that rule selects index ceil(q*n)-1, so persisted and
+            # streaming runs agree on identical data — the reference's
+            # incremental==batch metric-equality invariant
+            # (IncrementalAnalysisTest.scala:30-90)
             idx = jnp.clip(
-                jnp.round(jnp.asarray(qs) * jnp.maximum(count - 1, 0)),
+                jnp.ceil(jnp.asarray(qs) * count) - 1,
                 0, jnp.maximum(count - 1, 0),
             ).astype(jnp.int32)
             return sv[idx], count

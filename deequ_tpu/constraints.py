@@ -247,10 +247,6 @@ def mutual_information_constraint(column_a, column_b, assertion, hint=None) -> C
     )
 
 
-def entropy_based_histogram_constraint():  # pragma: no cover - placeholder parity
-    raise NotImplementedError
-
-
 def histogram_constraint(
     column, assertion, binning_udf=None, max_bins=None, hint=None
 ) -> Constraint:

@@ -37,6 +37,7 @@ class KLLSketchState:
         shrinking_factor: float = DEFAULT_SHRINKING_FACTOR,
         compactors: Optional[List[np.ndarray]] = None,
         count: int = 0,
+        rng_count: int = 0,
     ):
         self.sketch_size = int(sketch_size)
         self.shrinking_factor = float(shrinking_factor)
@@ -44,7 +45,24 @@ class KLLSketchState:
             [np.empty(0, dtype=np.float64)] if compactors is None else compactors
         )
         self.count = int(count)  # total items represented (by weight)
-        self._rng = np.random.default_rng(0xDEE0)
+        # compaction-randomness position: bits are drawn by hashing this
+        # counter (see _next_bit), so persisting it round-trips the random
+        # promote/retain choices exactly across save/load/update cycles
+        # (a resumed sketch continues the SAME bit stream instead of
+        # replaying it from the seed)
+        self.rng_count = int(rng_count)
+
+    def _next_bit(self) -> int:
+        """Deterministic, serializable bit source: splitmix64 finalizer of
+        the draw index. Machine-independent and position-restorable —
+        unlike a numpy Generator, whose internal state did not survive the
+        binary state codec (states/serde.py)."""
+        m = (1 << 64) - 1
+        z = (self.rng_count * 0x9E3779B97F4A7C15 + 0xDEE0DEE0) & m
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4B9B1) & m
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & m
+        self.rng_count += 1
+        return int((z ^ (z >> 31)) & 1)
 
     # -- capacities ---------------------------------------------------------
 
@@ -83,7 +101,7 @@ class KLLSketchState:
             # an odd-length buffer keeps one leftover item at this level so
             # total weight is preserved exactly; the even remainder compacts
             if len(buf) % 2 == 1:
-                keep_last = int(self._rng.integers(0, 2))
+                keep_last = self._next_bit()
                 if keep_last:
                     retained, to_compact = buf[-1:], buf[:-1]
                 else:
@@ -91,7 +109,7 @@ class KLLSketchState:
             else:
                 retained = np.empty(0, dtype=np.float64)
                 to_compact = buf
-            offset = int(self._rng.integers(0, 2))
+            offset = self._next_bit()
             promoted = to_compact[offset::2]
             self.compactors[level] = retained
             self.compactors[level + 1] = np.concatenate(
@@ -114,7 +132,8 @@ class KLLSketchState:
             b = other.compactors[i] if i < len(other.compactors) else np.empty(0)
             merged.append(np.concatenate([a, b]).astype(np.float64))
         out = KLLSketchState(
-            self.sketch_size, self.shrinking_factor, merged, self.count + other.count
+            self.sketch_size, self.shrinking_factor, merged,
+            self.count + other.count, self.rng_count + other.rng_count,
         )
         out._compress()
         return out
@@ -170,15 +189,19 @@ class KLLSketchState:
             self.shrinking_factor,
             self.count,
             tuple(tuple(float(x) for x in buf) for buf in self.compactors),
+            self.rng_count,
         )
 
     @staticmethod
     def deserialize(data: tuple) -> "KLLSketchState":
-        sketch_size, shrinking_factor, count, buffers = data
+        sketch_size, shrinking_factor, count, buffers = data[:4]
+        rng_count = data[4] if len(data) > 4 else 0
         compactors = [np.array(buf, dtype=np.float64) for buf in buffers]
         if not compactors:
             compactors = [np.empty(0, dtype=np.float64)]
-        return KLLSketchState(sketch_size, shrinking_factor, compactors, count)
+        return KLLSketchState(
+            sketch_size, shrinking_factor, compactors, count, rng_count
+        )
 
     @staticmethod
     def reconstruct(raw_buffers, parameters) -> "KLLSketchState":

@@ -147,12 +147,18 @@ def _tag_identity_wrap(tag: str, leaf):
 
 def _packs_as_i32(col: Column) -> bool:
     """Integral columns whose values fit int32 transfer at half width,
-    losslessly (upcast to f64 happens inside the jitted step)."""
+    losslessly (upcast to f64 happens inside the jitted step). The O(n)
+    min/max is computed once per Column and cached (repeated packer
+    construction over streaming batches / persisted tables reuses it)."""
     if col.dtype != DType.INTEGRAL or len(col.values) == 0:
         return False
-    lo = int(col.values.min())
-    hi = int(col.values.max())
-    return -(2 ** 31) < lo and hi < 2 ** 31
+    cached = getattr(col, "_i32_safe", None)
+    if cached is None:
+        lo = int(col.values.min())
+        hi = int(col.values.max())
+        cached = -(2 ** 31) < lo and hi < 2 ** 31
+        col._i32_safe = cached
+    return cached
 
 
 def _transfer_f32() -> bool:

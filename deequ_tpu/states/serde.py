@@ -9,6 +9,10 @@ from shared storage and stable across library versions.
 Layout: ``MAGIC(4) | VERSION(u16) | TYPE_TAG(u16) | payload``; all integers
 little-endian. Every stateful analyzer type has an explicit payload codec
 below; golden byte fixtures in tests/test_state_serde.py pin the format.
+
+Version history: v1 original; v2 appends the compaction-RNG position (i64)
+to the KLL payload (decoders keep reading v1, where it is absent and
+defaults to 0). Every payload decoder receives the envelope version.
 """
 
 from __future__ import annotations
@@ -19,7 +23,7 @@ from typing import Callable, Dict, Tuple, Type
 from deequ_tpu.analyzers.base import State
 
 MAGIC = b"DQTS"
-VERSION = 1
+VERSION = 2
 
 _u16 = struct.Struct("<H")
 _i64 = struct.Struct("<q")
@@ -98,7 +102,7 @@ def _codec_scalars(cls, fields: str):
     def enc(state) -> bytes:
         return fmt.pack(*(getattr(state, n) for n in names))
 
-    def dec(buf: bytes):
+    def dec(buf: bytes, version: int):
         return cls(*fmt.unpack(buf))
 
     return enc, dec
@@ -109,7 +113,7 @@ def _enc_hll(state) -> bytes:
     return _i64.pack(len(regs)) + bytes(int(r) & 0xFF for r in regs)
 
 
-def _dec_hll(buf: bytes):
+def _dec_hll(buf: bytes, version: int):
     from deequ_tpu.analyzers.sketches import ApproxCountDistinctState
 
     (n,) = _i64.unpack_from(buf, 0)
@@ -133,10 +137,14 @@ def _enc_kll(state) -> bytes:
         arr = np.asarray(buf, dtype="<f8")
         out.append(_i64.pack(len(arr)))
         out.append(arr.tobytes())
+    # v2 trailing field (absent in v1 blobs): compaction-RNG position, so
+    # incremental save/load/update cycles continue the same bit stream
+    # instead of replaying it
+    out.append(_i64.pack(sketch.rng_count))
     return b"".join(out)
 
 
-def _dec_kll(buf: bytes):
+def _dec_kll(buf: bytes, version: int):
     import numpy as np
 
     from deequ_tpu.analyzers.sketches import KLLState
@@ -156,7 +164,10 @@ def _dec_kll(buf: bytes):
             np.frombuffer(buf, dtype="<f8", count=n, offset=off).copy()
         )
         off += 8 * n
-    sketch = KLLSketchState(sketch_size, shrinking, compactors, count)
+    rng_count = 0
+    if version >= 2:  # v1 blobs predate the field; they decode as 0
+        (rng_count,) = _i64.unpack_from(buf, off)
+    sketch = KLLSketchState(sketch_size, shrinking, compactors, count, rng_count)
     return KLLState(sketch, gmin, gmax)
 
 
@@ -173,7 +184,7 @@ def _enc_freq(state) -> bytes:
     return b"".join(out)
 
 
-def _dec_freq(buf: bytes):
+def _dec_freq(buf: bytes, version: int):
     from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
 
     off = 0
@@ -267,4 +278,4 @@ def deserialize_state(data: bytes) -> State:
     if entry is None:
         raise ValueError(f"unknown state type tag {tag}")
     _cls, _enc, dec = entry
-    return dec(data[8:])
+    return dec(data[8:], version)

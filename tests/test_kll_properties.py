@@ -207,3 +207,67 @@ def test_persisted_table_gets_exact_device_quantiles():
     assert sp.load(a1) is not None  # KLL state persisted
     assert abs(ctx2.metric_map[a1].value.get() - exact) < 20.0
     table.unpersist()
+
+
+def test_rng_position_round_trips_through_serde():
+    """Incremental save/load/update must continue the SAME compaction bit
+    stream, not replay it from the seed (ADVICE r2): a sketch that is
+    serialized mid-stream and resumed must make byte-identical decisions
+    to one that never left memory."""
+    from deequ_tpu.states.serde import deserialize_state, serialize_state
+    from deequ_tpu.analyzers.sketches import KLLState
+
+    rng = np.random.default_rng(7)
+    a_data = rng.normal(0, 1, 30_000)
+    b_data = rng.normal(0, 1, 30_000)
+
+    live = KLLSketchState(sketch_size=128)
+    live.update_batch(a_data)
+
+    # round-trip through the binary codec mid-stream
+    blob = serialize_state(KLLState(live, -1.0, 1.0))
+    resumed = deserialize_state(blob).sketch
+    assert resumed.rng_count == live.rng_count
+
+    live.update_batch(b_data)
+    resumed.update_batch(b_data)
+    assert live.rng_count == resumed.rng_count
+    assert len(live.compactors) == len(resumed.compactors)
+    for x, y in zip(live.compactors, resumed.compactors):
+        assert np.array_equal(x, y)
+
+
+def test_persisted_exact_path_matches_sketch_rank_rule():
+    """ApproxQuantile(s) on a persisted table (exact device sort) must
+    return the same value as the streaming sketch path on identical data —
+    the reference's incremental==batch metric-equality invariant
+    (IncrementalAnalysisTest.scala:30-90). On data small enough that the
+    sketch never compacts (n=200 < the k=256 level-0 capacity), both
+    paths are exact and must agree bit-for-bit,
+    which pins the shared rank rule (searchsorted-left / ceil(q*n)-1)."""
+    from deequ_tpu.analyzers import ApproxQuantile, ApproxQuantiles
+    from deequ_tpu.data.table import ColumnarTable
+
+    rng = np.random.default_rng(3)
+    values = rng.normal(50.0, 10.0, 200)
+    qs = (0.1, 0.25, 0.5, 0.75, 0.9)
+
+    streamed = ColumnarTable.from_pydict({"x": list(values)})
+    persisted = ColumnarTable.from_pydict({"x": list(values)}).persist()
+
+    for q in qs:
+        m_stream = ApproxQuantile("x", q).calculate(streamed)
+        m_persist = ApproxQuantile("x", q).calculate(persisted)
+        assert m_stream.value.get() == m_persist.value.get(), q
+
+    ks = ApproxQuantiles("x", qs)
+    v_stream = ks.calculate(streamed).value.get()
+    v_persist = ks.calculate(persisted).value.get()
+    assert v_stream == v_persist
+
+    # even-count median: the historic divergence case (round-half-even vs
+    # ceil) — 200 values, q=0.5 picks element 99 under ceil(q*n)-1
+    sorted_v = np.sort(values)
+    assert ApproxQuantile("x", 0.5).calculate(persisted).value.get() == (
+        sorted_v[99]
+    )

@@ -68,15 +68,16 @@ def test_round_trip(state):
         assert back == state
 
 
-# golden fixtures: committed hex of the v1 encoding. If one of these fails,
-# the on-disk format changed — bump VERSION and keep decoding v1.
+# golden fixtures: committed hex of the current encoding. If one of these
+# fails, the on-disk format changed — bump VERSION and keep decoding all
+# older versions (v1 blobs must stay loadable forever).
 
 
 def test_golden_num_matches():
     data = serialize_state(NumMatches(42))
     assert data.hex() == (
         "44515453"  # magic DQTS
-        "0100"      # version 1
+        "0200"      # version 2
         "0100"      # tag 1
         "2a00000000000000"  # i64 42
     )
@@ -85,7 +86,7 @@ def test_golden_num_matches():
 def test_golden_mean_state():
     data = serialize_state(MeanState(1.5, 3))
     assert data.hex() == (
-        "44515453" "0100" "0500"
+        "44515453" "0200" "0500"
         "000000000000f83f"  # f64 1.5 LE
         "0300000000000000"  # i64 3
     )
@@ -95,10 +96,36 @@ def test_golden_hll_prefix():
     regs = tuple([2, 0, 5] + [0] * 509)
     data = serialize_state(ApproxCountDistinctState(regs))
     assert data.hex().startswith(
-        "44515453" "0100" "0a00"
+        "44515453" "0200" "0a00"
         "0002000000000000"  # i64 512 (0x200)
         "020005"            # first three registers as bytes
     )
+
+
+def test_v1_blob_still_decodes():
+    """A v1 envelope (no KLL rng_count trailing field) must keep loading:
+    states are durable artifacts. Fixture = v1 bytes of a 1-level sketch
+    holding [1.5], count 1."""
+    v1 = bytes.fromhex(
+        "44515453" "0100" "0b00"          # magic, version 1, tag 11 (KLL)
+        "0008000000000000"                 # sketch_size 2048
+        "7b14ae47e17ae43f"                 # shrinking_factor 0.64
+        "0100000000000000"                 # count 1
+        "000000000000f83f"                 # global_min 1.5
+        "000000000000f83f"                 # global_max 1.5
+        "0100000000000000"                 # 1 level
+        "0100000000000000"                 # level 0: 1 item
+        "000000000000f83f"                 # 1.5
+    )
+    state = deserialize_state(v1)
+    assert state.sketch.count == 1
+    assert state.sketch.rng_count == 0
+    assert state.sketch.quantile(0.5) == 1.5
+
+
+def test_v1_scalar_blob_still_decodes():
+    v1 = bytes.fromhex("44515453" "0100" "0100" "2a00000000000000")
+    assert deserialize_state(v1) == NumMatches(42)
 
 
 def test_file_system_provider_uses_binary(tmp_path):
