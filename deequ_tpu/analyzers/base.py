@@ -156,10 +156,29 @@ class Analyzer(ABC):
         if failing is not None:
             return self.to_failure_metric(failing)
         try:
-            state = self.compute_state_from(table)
+            if getattr(table, "is_streaming", False):
+                state = self.compute_state_from_stream(table)
+            else:
+                state = self.compute_state_from(table)
         except Exception as e:  # noqa: BLE001
             return self.to_failure_metric(wrap_if_necessary(e))
         return self.calculate_metric(state, aggregate_with, save_states_with)
+
+    def compute_state_from_stream(self, stream) -> Optional[State]:
+        """Out-of-core state: fold the monoid per batch — the same
+        ``State.sum`` merge used across devices and incremental runs,
+        applied across stream batches. Scan-shareable analyzers override
+        this (the fused scan engine streams them in one pipelined pass)."""
+        state: Optional[State] = None
+        for batch in stream.batches(columns=self._stream_columns()):
+            state = merge_states(state, self.compute_state_from(batch))
+        return state
+
+    def _stream_columns(self) -> Optional[List[str]]:
+        """Columns to read when streaming (None = all); overridden by
+        analyzers that know their column set, enabling storage-side
+        column pruning."""
+        return None
 
     def calculate_metric(
         self, state: Optional[State], aggregate_with=None, save_states_with=None
@@ -230,6 +249,11 @@ class ScanShareableAnalyzer(Analyzer):
         op = self.scan_op(table)
         (result,) = run_scan(table, [op])
         return self.state_from_scan_result(result)
+
+    def compute_state_from_stream(self, stream) -> Optional[State]:
+        # the fused scan engine streams batches itself (one pipelined pass,
+        # pinned packer layout) — no per-batch state fold needed
+        return self.compute_state_from(stream)
 
 
 def metric_from_value(

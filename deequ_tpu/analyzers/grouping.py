@@ -105,6 +105,9 @@ class FrequencyBasedAnalyzer(Analyzer):
         freqs, num_rows = group_counts(table, self.group_columns)
         return FrequenciesAndNumRows.from_dict(self.group_columns, freqs, num_rows)
 
+    def _stream_columns(self):
+        return list(self.group_columns)
+
 
 class ScanShareableFrequencyBasedAnalyzer(FrequencyBasedAnalyzer):
     """Computes one double from the shared frequency table

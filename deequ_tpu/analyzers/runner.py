@@ -178,12 +178,19 @@ class AnalysisRunner:
             data, scanning, aggregate_with, save_states_with
         )
 
-        # own-pass analyzers (KLL extra pass analogue, reference L155-160)
+        # own-pass analyzers (KLL extra pass analogue, reference L155-160);
+        # on a stream they share ONE batch loop — N analyzers must not cost
+        # N full storage reads
         own_ctx = AnalyzerContext.empty()
-        for analyzer in own_pass:
-            own_ctx.metric_map[analyzer] = analyzer.calculate(
-                data, aggregate_with, save_states_with
+        if own_pass and getattr(data, "is_streaming", False):
+            own_ctx += AnalysisRunner._run_own_pass_streaming(
+                data, own_pass, aggregate_with, save_states_with
             )
+        else:
+            for analyzer in own_pass:
+                own_ctx.metric_map[analyzer] = analyzer.calculate(
+                    data, aggregate_with, save_states_with
+                )
 
         # (5) grouping analyzers share one frequency table per distinct
         # sorted grouping-column set (reference L175-190)
@@ -267,6 +274,62 @@ class AnalysisRunner:
         return ctx
 
     @staticmethod
+    def _run_own_pass_streaming(
+        data,
+        analyzers: Sequence[Analyzer],
+        aggregate_with=None,
+        save_states_with=None,
+    ) -> AnalyzerContext:
+        """Fold every own-pass analyzer's monoid state over ONE shared pass
+        of the stream (reading the columns any of them needs), instead of
+        one full storage scan per analyzer. An analyzer whose per-batch
+        update raises drops out with a failure metric; the others keep
+        folding."""
+        from deequ_tpu.analyzers.base import merge_states
+
+        columns: Optional[set] = set()
+        for a in analyzers:
+            cols = a._stream_columns()
+            if cols is None:
+                columns = None
+                break
+            columns.update(cols)
+
+        states: Dict[Analyzer, Optional[State]] = {a: None for a in analyzers}
+        failed: Dict[Analyzer, Exception] = {}
+        try:
+            for batch in data.batches(
+                columns=sorted(columns) if columns is not None else None
+            ):
+                for a in analyzers:
+                    if a in failed:
+                        continue
+                    try:
+                        states[a] = merge_states(
+                            states[a], a.compute_state_from(batch)
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        failed[a] = e
+        except Exception as e:  # noqa: BLE001 — a source/read error fails
+            # every analyzer of the pass (the shared-scan failure rule)
+            wrapped = wrap_if_necessary(e)
+            return AnalyzerContext(
+                {a: a.to_failure_metric(wrapped) for a in analyzers}
+            )
+
+        ctx = AnalyzerContext.empty()
+        for a in analyzers:
+            if a in failed:
+                ctx.metric_map[a] = a.to_failure_metric(
+                    wrap_if_necessary(failed[a])
+                )
+            else:
+                ctx.metric_map[a] = a.calculate_metric(
+                    states[a], aggregate_with, save_states_with
+                )
+        return ctx
+
+    @staticmethod
     def _run_grouping_analyzers(
         data: ColumnarTable,
         grouping_columns: List[str],
@@ -275,6 +338,38 @@ class AnalysisRunner:
         save_states_with=None,
     ) -> AnalyzerContext:
         from deequ_tpu.ops.segment import group_count_stats, group_counts
+
+        # out-of-core: fold the frequency monoid per batch (the same
+        # outer-join-sum merge used for incremental states,
+        # GroupingAnalyzers.scala:127-147) — the count-stats fast path
+        # needs global counts, so it does not apply batchwise
+        if getattr(data, "is_streaming", False):
+            merged: Optional[FrequenciesAndNumRows] = None
+            try:
+                for batch in data.batches(columns=grouping_columns):
+                    freqs, num_rows = group_counts(batch, grouping_columns)
+                    s = FrequenciesAndNumRows.from_dict(
+                        grouping_columns, freqs, num_rows
+                    )
+                    merged = s if merged is None else merged.sum(s)
+            except Exception as e:  # noqa: BLE001
+                wrapped = wrap_if_necessary(e)
+                return AnalyzerContext(
+                    {a: a.to_failure_metric(wrapped) for a in analyzers}
+                )
+            ctx = AnalyzerContext.empty()
+            for analyzer in analyzers:
+                own_state = (
+                    FrequenciesAndNumRows.from_dict(
+                        grouping_columns, merged.as_dict(), merged.num_rows
+                    )
+                    if merged is not None
+                    else None
+                )
+                ctx.metric_map[analyzer] = analyzer.calculate_metric(
+                    own_state, aggregate_with, save_states_with
+                )
+            return ctx
 
         # count-stats fast path: when nobody needs the materialized
         # frequency table (no state persistence/merge, and every analyzer

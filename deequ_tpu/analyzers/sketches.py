@@ -284,6 +284,9 @@ class KLLSketch(Analyzer):
         p = self.params
         return _sketch_column(table, self.column, p.sketch_size, p.shrinking_factor)
 
+    def _stream_columns(self):
+        return [self.column]
+
     def compute_metric_from(self, state: Optional[KLLState]) -> KLLMetric:
         if state is None:
             return KLLMetric(
@@ -429,6 +432,13 @@ class ApproxQuantile(Analyzer):
             where_mask=where_mask,
         )
 
+    def _stream_columns(self):
+        if self.where is None:
+            return [self.column]
+        from deequ_tpu.expr.parser import parse_expression
+
+        return sorted({self.column} | parse_expression(self.where).columns())
+
     def compute_metric_from(self, state: Optional[KLLState]) -> DoubleMetric:
         if state is None:
             return self.to_failure_metric(
@@ -500,6 +510,9 @@ class ApproxQuantiles(Analyzer):
             table, self.column,
             _sketch_size_for_error(self.relative_error), DEFAULT_SHRINKING_FACTOR,
         )
+
+    def _stream_columns(self):
+        return [self.column]
 
     def compute_metric_from(self, state: Optional[KLLState]) -> KeyedDoubleMetric:
         if state is None:

@@ -1,3 +1,21 @@
 from deequ_tpu.data.table import Column, ColumnarTable, DType, Schema
+from deequ_tpu.data.source import (
+    BatchSource,
+    GeneratorBatchSource,
+    ParquetBatchSource,
+    TableBatchSource,
+)
+from deequ_tpu.data.streaming import StreamingTable, stream_table
 
-__all__ = ["Column", "ColumnarTable", "DType", "Schema"]
+__all__ = [
+    "Column",
+    "ColumnarTable",
+    "DType",
+    "Schema",
+    "BatchSource",
+    "GeneratorBatchSource",
+    "ParquetBatchSource",
+    "TableBatchSource",
+    "StreamingTable",
+    "stream_table",
+]

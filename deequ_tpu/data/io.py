@@ -139,6 +139,63 @@ def from_arrow(table) -> ColumnarTable:
     return ColumnarTable(cols)
 
 
+def to_arrow(table: ColumnarTable):
+    """Convert a ColumnarTable to a pyarrow Table (nulls preserved)."""
+    import pyarrow as pa
+
+    arrays = {}
+    for name in table.column_names:
+        col = table[name]
+        if col.dtype == DType.STRING:
+            arrays[name] = pa.array(col.to_pylist(), type=pa.string())
+        else:
+            values = col.values
+            if col.mask.all():
+                arrays[name] = pa.array(values)
+            else:
+                arrays[name] = pa.array(
+                    values, mask=~np.asarray(col.mask, dtype=bool)
+                )
+    return pa.table(arrays)
+
+
+def write_parquet(table: ColumnarTable, path: str, row_group_rows: int = 1 << 20) -> None:
+    """Write a ColumnarTable to one Parquet file."""
+    import pyarrow.parquet as pq
+
+    pq.write_table(to_arrow(table), path, row_group_size=row_group_rows)
+
+
+def write_parquet_stream(batches, path: str) -> int:
+    """Write an iterator of ColumnarTable batches to one Parquet file
+    without ever holding more than a batch (benchmark/data-prep helper for
+    out-of-core datasets). Returns the number of rows written."""
+    import pyarrow.parquet as pq
+
+    writer = None
+    rows = 0
+    try:
+        for batch in batches:
+            arrow = to_arrow(batch)
+            if writer is None:
+                writer = pq.ParquetWriter(path, arrow.schema)
+            writer.write_table(arrow)
+            rows += batch.num_rows
+    finally:
+        if writer is not None:
+            writer.close()
+    return rows
+
+
+def stream_parquet(paths, columns=None, batch_rows=None):
+    """Open Parquet file(s) as a StreamingTable — the out-of-core entry
+    point: analyses run over it in bounded host memory."""
+    from deequ_tpu.data.source import ParquetBatchSource
+    from deequ_tpu.data.streaming import StreamingTable
+
+    return StreamingTable(ParquetBatchSource(paths, columns, batch_rows))
+
+
 def from_pandas(df) -> ColumnarTable:
     """Convert a pandas DataFrame."""
     import pandas as pd

@@ -1,0 +1,211 @@
+"""Out-of-core batch sources — bounded-memory ingestion.
+
+The reference reads TB datasets because Spark streams partitions from
+storage instead of materializing tables (the 3-pass profiler is explicitly
+designed around that, reference profiles/ColumnProfiler.scala:57-68). The
+TPU-native analogue: a ``BatchSource`` yields fixed-size ``ColumnarTable``
+batches straight off storage; the scan engine packs each batch into device
+chunks with a read-ahead thread so host decode, host->device transfer, and
+device compute overlap. Host RSS stays bounded by
+O(batch_rows x row_width x read_ahead), independent of dataset size.
+
+``ParquetBatchSource`` streams row batches via
+``pyarrow.ParquetFile.iter_batches`` — the schema and row count come from
+file metadata, so nothing is read until batches are consumed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deequ_tpu.data.table import Column, ColumnarTable, DType, Field, Schema
+
+# target host bytes per streamed batch (decoded numpy, before packing)
+DEFAULT_BATCH_BYTES = 256 << 20
+
+
+def batch_rows_for_schema(schema: Schema, target_bytes: int = DEFAULT_BATCH_BYTES) -> int:
+    """Rows per batch so a decoded batch is ~target_bytes on host."""
+    bytes_per_row = 0
+    for f in schema:
+        bytes_per_row += 4 if f.dtype == DType.STRING else 9  # value + mask
+    bytes_per_row = max(bytes_per_row, 1)
+    return int(min(max(target_bytes // bytes_per_row, 1 << 16), 1 << 24))
+
+
+class BatchSource:
+    """Protocol for bounded-memory batch producers."""
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def num_rows(self) -> Optional[int]:
+        """Total rows if knowable from metadata, else None."""
+        return None
+
+    def batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        raise NotImplementedError
+
+
+def _arrow_field_dtype(pa_type) -> DType:
+    import pyarrow as pa
+
+    if pa.types.is_integer(pa_type):
+        return DType.INTEGRAL
+    if pa.types.is_floating(pa_type):
+        return DType.FRACTIONAL
+    if pa.types.is_boolean(pa_type):
+        return DType.BOOLEAN
+    return DType.STRING
+
+
+class ParquetBatchSource(BatchSource):
+    """Stream one or more Parquet files as ColumnarTable batches.
+
+    Schema and total row count come from file metadata (no data read);
+    ``batches`` decodes ``pyarrow.ParquetFile.iter_batches`` output one
+    batch at a time — the whole file is never materialized.
+    """
+
+    def __init__(
+        self,
+        paths,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+        pre_buffer: bool = False,
+    ):
+        import pyarrow.parquet as pq
+
+        # pre_buffer=True (pyarrow's default) reads ALL row groups ahead —
+        # O(file) host memory, exactly what out-of-core must avoid; False
+        # streams row groups on demand (measured: 1GB file iterates at
+        # 0.35GB RSS vs 1.44GB pre-buffered). Set True only for
+        # high-latency object stores where random reads dominate.
+        self.pre_buffer = pre_buffer
+        self.paths: List[str] = [paths] if isinstance(paths, str) else list(paths)
+        if not self.paths:
+            raise ValueError("ParquetBatchSource needs at least one path")
+        self._restrict = list(columns) if columns is not None else None
+        self._batch_rows = batch_rows
+        # metadata-only pass: schema + row count without reading data pages
+        first = pq.ParquetFile(self.paths[0])
+        arrow_schema = first.schema_arrow
+        names = (
+            self._restrict
+            if self._restrict is not None
+            else list(arrow_schema.names)
+        )
+        fields = []
+        for name in names:
+            idx = arrow_schema.get_field_index(name)
+            if idx < 0:
+                raise ValueError(f"column {name!r} not in parquet schema")
+            fields.append(Field(name, _arrow_field_dtype(arrow_schema.field(idx).type)))
+        self._schema = Schema(fields)
+        n = first.metadata.num_rows
+        for path in self.paths[1:]:
+            n += pq.ParquetFile(path).metadata.num_rows
+        self._num_rows = int(n)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_rows(self) -> Optional[int]:
+        return self._num_rows
+
+    def batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from deequ_tpu.data.io import from_arrow
+
+        names = list(columns) if columns is not None else self._schema.column_names
+        names = [n for n in self._schema.column_names if n in set(names)]
+        rows = batch_rows or self._batch_rows or batch_rows_for_schema(
+            Schema([self._schema[n] for n in names])
+        )
+        for path in self.paths:
+            pf = pq.ParquetFile(path, pre_buffer=self.pre_buffer)
+            for record_batch in pf.iter_batches(batch_size=rows, columns=names):
+                yield from_arrow(pa.Table.from_batches([record_batch]))
+
+
+class TableBatchSource(BatchSource):
+    """Adapter: slice an in-memory ColumnarTable into batches (testing and
+    incremental pipelines that already hold batches in memory)."""
+
+    def __init__(self, table: ColumnarTable, batch_rows: Optional[int] = None):
+        self.table = table
+        self._batch_rows = batch_rows
+
+    @property
+    def schema(self) -> Schema:
+        return self.table.schema
+
+    @property
+    def num_rows(self) -> Optional[int]:
+        return self.table.num_rows
+
+    def batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        rows = batch_rows or self._batch_rows or batch_rows_for_schema(self.schema)
+        names = (
+            [n for n in self.table.column_names if n in set(columns)]
+            if columns is not None
+            else self.table.column_names
+        )
+        n = self.table.num_rows
+        view = self.table.select(names)
+        for start in range(0, max(n, 1), rows):
+            idx = np.arange(start, min(start + rows, n))
+            yield ColumnarTable([view[c].take(idx) for c in names])
+            if start + rows >= n:
+                break
+
+
+class GeneratorBatchSource(BatchSource):
+    """Batches from a factory of iterators (synthetic benchmark streams:
+    data is generated on the fly, never held in full)."""
+
+    def __init__(self, schema: Schema, factory, num_rows: Optional[int] = None):
+        self._schema = schema
+        self._factory = factory  # () -> Iterator[ColumnarTable]
+        self._num_rows = num_rows
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_rows(self) -> Optional[int]:
+        return self._num_rows
+
+    def batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        keep = set(columns) if columns is not None else None
+        for batch in self._factory():
+            if keep is not None:
+                names = [n for n in batch.column_names if n in keep]
+                yield batch.select(names)
+            else:
+                yield batch

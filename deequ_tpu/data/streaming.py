@@ -1,0 +1,211 @@
+"""StreamingTable — a table handle over a BatchSource, never materialized.
+
+Passing a StreamingTable anywhere a ColumnarTable is accepted
+(VerificationSuite.on_data, AnalysisRunner, ColumnProfiler, Histogram, ...)
+runs the SAME analysis out-of-core:
+
+- scan-shareable analyzers stream through the fused scan engine in one
+  pipelined pass (scan_engine.run_scan detects the streaming handle);
+- every other analyzer folds its monoid state per batch
+  (``state = state.sum(compute_state_from(batch))``) — the same merge used
+  across devices and across incremental runs, applied across batches.
+
+Host memory stays bounded by the batch size regardless of dataset size —
+the structural property that lets the reference profile TB datasets
+(profiles/ColumnProfiler.scala:57-68).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from deequ_tpu.data.source import BatchSource, TableBatchSource
+from deequ_tpu.data.table import ColumnarTable, DType, Field, Schema
+
+
+class _SchemaColumn:
+    """Schema-only view of a streamed column: carries name/dtype (all the
+    planner needs to build scan ops) and refuses data access with a clear
+    error instead of silently materializing."""
+
+    __slots__ = ("name", "dtype")
+
+    def __init__(self, name: str, dtype: DType):
+        self.name = name
+        self.dtype = dtype
+
+    def __getattr__(self, item):
+        raise AttributeError(
+            f"column {self.name!r} belongs to a StreamingTable; its data is "
+            f"never materialized — iterate table.batches() instead"
+        )
+
+
+class StreamingTable:
+    """Out-of-core table: schema + batch iterator, no resident data."""
+
+    is_streaming = True
+    is_persisted = False
+    _device_cache = None
+
+    def __init__(
+        self,
+        source: BatchSource,
+        transforms: Optional[
+            List[Tuple[Callable[[ColumnarTable], ColumnarTable], frozenset]]
+        ] = None,
+        schema_override: Optional[Schema] = None,
+    ):
+        # each transform is (fn, input_columns): the inputs are added to
+        # column-pruned reads so transforms keep working without forcing a
+        # full-width read of the source
+        self.source = source
+        self._transforms = list(transforms or [])
+        self._schema = schema_override or source.schema
+
+    # -- schema surface (everything the planner touches) --------------------
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def column_names(self) -> List[str]:
+        return self._schema.column_names
+
+    @property
+    def preferred_batch_rows(self) -> Optional[int]:
+        """Source-configured batch size (the user's host-memory budget);
+        the scan engine sizes its chunks to it."""
+        return getattr(self.source, "_batch_rows", None)
+
+    @property
+    def num_rows(self) -> int:
+        n = self.source.num_rows
+        if n is None:
+            raise TypeError(
+                "this StreamingTable's source does not know its row count; "
+                "use Size() to measure it in a scan"
+            )
+        return n
+
+    def __contains__(self, name: str) -> bool:
+        return self._schema.has_column(name)
+
+    def __getitem__(self, name: str) -> _SchemaColumn:
+        f = self._schema[name]
+        return _SchemaColumn(f.name, f.dtype)
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __repr__(self) -> str:
+        return f"StreamingTable({self._schema})"
+
+    # -- batches -------------------------------------------------------------
+
+    def batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        """Yield ColumnarTable batches (optionally column-pruned)."""
+        if self._transforms:
+            # read the requested columns plus every transform input, apply
+            # transforms per batch, then prune to the request
+            read: Optional[List[str]] = None
+            if columns is not None:
+                want = set(columns)
+                for _, inputs in self._transforms:
+                    want |= inputs
+                read = [n for n in self.source.schema.column_names if n in want]
+            for raw in self.source.batches(columns=read, batch_rows=batch_rows):
+                batch = raw
+                for fn, _ in self._transforms:
+                    batch = fn(batch)
+                if columns is not None:
+                    keep = set(columns)
+                    batch = batch.select(
+                        [n for n in batch.column_names if n in keep]
+                    )
+                yield batch
+        else:
+            yield from self.source.batches(columns=columns, batch_rows=batch_rows)
+
+    # -- lazy per-batch column casts (profiler pass-2 support) ---------------
+
+    def with_casts(self, casts: Dict[str, DType]) -> "StreamingTable":
+        """A new StreamingTable whose string columns named in ``casts`` are
+        cast to numeric per batch (unparsable values become null) — the
+        out-of-core analogue of ColumnProfiler.castColumn."""
+        from deequ_tpu.data.cast import cast_string_column
+
+        def transform(batch: ColumnarTable) -> ColumnarTable:
+            out = batch
+            for name, target in casts.items():
+                if name in out and out[name].dtype == DType.STRING:
+                    out = out.with_column(cast_string_column(out[name], target))
+            return out
+
+        fields = [
+            Field(f.name, casts.get(f.name, f.dtype))
+            if f.name in casts
+            else f
+            for f in self._schema
+        ]
+        return StreamingTable(
+            self.source,
+            self._transforms + [(transform, frozenset(casts))],
+            Schema(fields),
+        )
+
+    # -- materialization guards ----------------------------------------------
+
+    def persist(self, mesh=None) -> "StreamingTable":
+        raise TypeError(
+            "a StreamingTable cannot be persisted to HBM — it is unbounded "
+            "by design; read it into a ColumnarTable first if it fits"
+        )
+
+    def unpersist(self) -> "StreamingTable":
+        return self
+
+    def collect(self, batch_rows: Optional[int] = None) -> ColumnarTable:
+        """Materialize the full stream (testing / small sources only)."""
+        merged: Optional[ColumnarTable] = None
+        for batch in self.batches(batch_rows=batch_rows):
+            merged = batch if merged is None else merged.concat(batch)
+        if merged is None:
+            merged = _empty_table(self._schema)
+        return merged
+
+
+def _empty_table(schema: Schema) -> ColumnarTable:
+    import numpy as np
+
+    cols = []
+    for f in schema:
+        if f.dtype == DType.STRING:
+            from deequ_tpu.data.table import Column
+
+            cols.append(
+                Column(
+                    f.name, DType.STRING,
+                    codes=np.empty(0, dtype=np.int32),
+                    dictionary=np.empty(0, dtype=object),
+                )
+            )
+        else:
+            from deequ_tpu.data.table import Column
+
+            cols.append(Column(f.name, f.dtype, values=np.empty(0)))
+    return ColumnarTable(cols)
+
+
+def is_streaming(table) -> bool:
+    return bool(getattr(table, "is_streaming", False))
+
+
+def stream_table(table: ColumnarTable, batch_rows: Optional[int] = None) -> StreamingTable:
+    """Wrap an in-memory table as a stream (testing helper)."""
+    return StreamingTable(TableBatchSource(table, batch_rows))

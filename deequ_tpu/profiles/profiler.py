@@ -112,22 +112,14 @@ def _cast_string_column_to_numeric(
 ) -> Column:
     """Cast a string column whose inferred type is numeric — unparsable
     values become null (the analogue of ColumnProfiler.castColumn)."""
-    card = max(len(col.dictionary), 1)
-    lut = np.zeros(card, dtype=np.float64)
-    ok = np.zeros(card, dtype=np.bool_)
-    for i, v in enumerate(col.dictionary):
-        try:
-            lut[i] = float(v)
-            ok[i] = True
-        except (TypeError, ValueError):
-            pass
-    safe = np.maximum(col.codes, 0)
-    values = lut[safe]
-    mask = (col.codes >= 0) & ok[safe]
-    if target == DataTypeInstances.INTEGRAL:
-        return Column(col.name, DType.INTEGRAL,
-                      values=values.astype(np.int64), mask=mask)
-    return Column(col.name, DType.FRACTIONAL, values=values, mask=mask)
+    from deequ_tpu.data.cast import cast_string_column
+
+    dtype = (
+        DType.INTEGRAL
+        if target == DataTypeInstances.INTEGRAL
+        else DType.FRACTIONAL
+    )
+    return cast_string_column(col, dtype)
 
 
 _NATIVE_TYPES = {
@@ -173,7 +165,8 @@ class ColumnProfiler:
         # AnalysisRunner.scala:493-497) — on the slow host->device link this
         # turns passes 2..3 from transfer-bound into compute-bound
         auto_persisted = []
-        if not data.is_persisted:
+        streaming = getattr(data, "is_streaming", False)
+        if not data.is_persisted and not streaming:
             try:
                 data.persist()
                 auto_persisted.append(data)
@@ -249,11 +242,29 @@ class ColumnProfiler:
                 type_counts[name] = {}
 
         # cast string columns that are inferred numeric (scala L153-154)
+        to_cast = [
+            name
+            for name in relevant
+            if data[name].dtype == DType.STRING
+            and inferred_type[name]
+            in (DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL)
+        ]
         casted = data
-        for name in relevant:
-            if data[name].dtype == DType.STRING and inferred_type[name] in (
-                DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL,
-            ):
+        if getattr(data, "is_streaming", False):
+            if to_cast:
+                # out-of-core: cast lazily per batch, bounded memory
+                casted = data.with_casts(
+                    {
+                        name: (
+                            DType.INTEGRAL
+                            if inferred_type[name] == DataTypeInstances.INTEGRAL
+                            else DType.FRACTIONAL
+                        )
+                        for name in to_cast
+                    }
+                )
+        else:
+            for name in to_cast:
                 casted = casted.with_column(
                     _cast_string_column_to_numeric(data[name], inferred_type[name])
                 )
@@ -276,7 +287,12 @@ class ColumnProfiler:
             ]
             if kll_profiling:
                 numeric_analyzers.append(KLLSketch(name, kll_parameters))
-        if casted is not data and numeric_analyzers and not casted.is_persisted:
+        if (
+            casted is not data
+            and numeric_analyzers
+            and not casted.is_persisted
+            and not getattr(casted, "is_streaming", False)
+        ):
             try:
                 casted.persist()
                 auto_persisted.append(casted)

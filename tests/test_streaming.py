@@ -1,0 +1,252 @@
+"""Out-of-core streaming ingestion: a StreamingTable must produce the same
+metrics as the materialized table (the monoid fold across batches IS the
+monoid fold across partitions/devices), with host memory bounded by the
+batch size — the TB-scale design intent of the reference
+(profiles/ColumnProfiler.scala:57-68)."""
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    CountDistinct,
+    DataType,
+    Distinctness,
+    Entropy,
+    Histogram,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    MutualInformation,
+    PatternMatch,
+    Size,
+    StandardDeviation,
+    Sum,
+    Uniqueness,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.data.io import stream_parquet, write_parquet, write_parquet_stream
+from deequ_tpu.data.streaming import StreamingTable, stream_table
+from deequ_tpu.data.table import ColumnarTable
+
+
+@pytest.fixture(scope="module")
+def mixed_table():
+    rng = np.random.default_rng(11)
+    n = 30_000
+    v = rng.normal(10.0, 3.0, n)
+    mask_holes = rng.integers(0, n, n // 50)
+    vals = [None if i in set(mask_holes.tolist()) else float(x)
+            for i, x in enumerate(v)]
+    return ColumnarTable.from_pydict({
+        "id": list(range(n)),
+        "v": vals,
+        "cat": [f"c{i % 13}" for i in range(n)],
+        "email": [
+            "a@b.com" if i % 3 == 0 else "nope" for i in range(n)
+        ],
+    })
+
+
+ANALYZERS = [
+    Size(),
+    Completeness("v"),
+    Mean("v"),
+    Sum("v"),
+    Minimum("v"),
+    Maximum("v"),
+    StandardDeviation("v"),
+    ApproxCountDistinct("id"),
+    DataType("email"),
+    PatternMatch("email", r"^[a-z]+@[a-z]+\.[a-z]+$"),
+    Uniqueness(["id"]),
+    Distinctness(["cat"]),
+    CountDistinct(["cat"]),
+    Entropy("cat"),
+    MutualInformation("cat", "email"),
+]
+
+
+def _values(ctx):
+    out = {}
+    for a, m in ctx.metric_map.items():
+        assert m.value.is_success, (a, m.value)
+        v = m.value.get()
+        out[repr(a)] = v if isinstance(v, float) else repr(v)
+    return out
+
+
+def test_streamed_equals_materialized(mixed_table):
+    batch = stream_table(mixed_table, batch_rows=7_000)  # uneven batches
+    ctx_mem = AnalysisRunner.do_analysis_run(mixed_table, ANALYZERS)
+    ctx_stream = AnalysisRunner.do_analysis_run(batch, ANALYZERS)
+    mem, stream = _values(ctx_mem), _values(ctx_stream)
+    assert set(mem) == set(stream)
+    for k in mem:
+        if isinstance(mem[k], float):
+            assert mem[k] == pytest.approx(stream[k], rel=1e-9, nan_ok=True), k
+        else:
+            assert mem[k] == stream[k], k
+
+
+def test_streamed_histogram_and_kll(mixed_table):
+    stream = stream_table(mixed_table, batch_rows=9_000)
+    h_mem = Histogram("cat").calculate(mixed_table).value.get()
+    h_stream = Histogram("cat").calculate(stream).value.get()
+    assert h_mem.values == h_stream.values
+    assert h_mem.number_of_bins == h_stream.number_of_bins
+
+    k_stream = KLLSketch("v").calculate(stream)
+    assert k_stream.value.is_success
+    dist = k_stream.value.get()
+    # bucket counts must sum to the non-null count
+    total = sum(b.count for b in dist.buckets)
+    assert total == mixed_table["v"].num_valid
+
+
+def test_parquet_round_trip_and_stream(tmp_path, mixed_table):
+    path = str(tmp_path / "t.parquet")
+    write_parquet(mixed_table, path, row_group_rows=8_192)
+    stream = stream_parquet(path, batch_rows=6_000)
+    assert stream.num_rows == mixed_table.num_rows
+    assert set(stream.column_names) == set(mixed_table.column_names)
+
+    ctx_mem = AnalysisRunner.do_analysis_run(mixed_table, ANALYZERS)
+    ctx_pq = AnalysisRunner.do_analysis_run(stream, ANALYZERS)
+    mem, pq = _values(ctx_mem), _values(ctx_pq)
+    for k in mem:
+        if isinstance(mem[k], float):
+            assert mem[k] == pytest.approx(pq[k], rel=1e-9, nan_ok=True), k
+        else:
+            assert mem[k] == pq[k], k
+
+
+def test_write_parquet_stream_bounded(tmp_path):
+    """write_parquet_stream + stream_parquet: build a dataset bigger than
+    any single batch without ever materializing it, then analyze it."""
+    path = str(tmp_path / "big.parquet")
+    n_batches, rows = 10, 5_000
+
+    def gen():
+        rng = np.random.default_rng(0)
+        for i in range(n_batches):
+            yield ColumnarTable.from_pydict({
+                "x": list(rng.normal(float(i), 1.0, rows)),
+                "k": list(range(i * rows, (i + 1) * rows)),
+            })
+
+    written = write_parquet_stream(gen(), path)
+    assert written == n_batches * rows
+
+    stream = stream_parquet(path, batch_rows=4_000)
+    ctx = AnalysisRunner.do_analysis_run(
+        stream, [Size(), Mean("x"), Uniqueness(["k"])]
+    )
+    vals = _values(ctx)
+    assert vals[repr(Size())] == written
+    assert vals[repr(Uniqueness(["k"]))] == 1.0
+    # mean of batch means 0..9 = 4.5 (exact batch sizes equal)
+    assert vals[repr(Mean("x"))] == pytest.approx(4.5, abs=0.05)
+
+
+def test_streaming_table_never_materializes(mixed_table):
+    """The guard: full-column access on a StreamingTable raises instead of
+    silently materializing."""
+    stream = stream_table(mixed_table)
+    col = stream["v"]
+    assert col.dtype.name == "FRACTIONAL"
+    with pytest.raises(AttributeError, match="never materialized"):
+        _ = col.values
+    with pytest.raises(TypeError, match="cannot be persisted"):
+        stream.persist()
+
+
+def test_streaming_verification_suite(mixed_table):
+    from deequ_tpu import Check, CheckLevel, VerificationSuite
+
+    stream = stream_table(mixed_table, batch_rows=8_000)
+    check = (
+        Check(CheckLevel.ERROR, "stream")
+        .has_size(lambda n: n == mixed_table.num_rows)
+        .is_complete("id")
+        .is_unique("id")
+        .has_mean("v", lambda m: 9.5 < m < 10.5)
+        .has_number_of_distinct_values("cat", lambda n: n == 13)
+    )
+    result = VerificationSuite.on_data(stream).add_check(check).run()
+    assert result.status.name == "SUCCESS"
+
+
+def test_streaming_profiler(tmp_path, mixed_table):
+    """3-pass profiler over a Parquet stream: numeric stats, inferred types
+    (string col of numbers cast per batch), low-cardinality histograms."""
+    from deequ_tpu.profiles import ColumnProfiler
+
+    n = 10_000
+    rng = np.random.default_rng(5)
+    t = ColumnarTable.from_pydict({
+        "num": list(rng.normal(5.0, 1.0, n)),
+        "numstr": [str(i % 997) for i in range(n)],
+        "cat": [f"g{i % 7}" for i in range(n)],
+    })
+    path = str(tmp_path / "p.parquet")
+    write_parquet(t, path, row_group_rows=2_048)
+
+    profiles_mem = ColumnProfiler.profile(t)
+    profiles_stream = ColumnProfiler.profile(stream_parquet(path, batch_rows=3_000))
+
+    assert profiles_stream.num_records == n
+    for name in ("num", "numstr", "cat"):
+        pm = profiles_mem.profiles[name]
+        ps = profiles_stream.profiles[name]
+        assert pm.data_type == ps.data_type, name
+        assert pm.completeness == ps.completeness, name
+        assert (
+            pm.approximate_num_distinct_values
+            == ps.approximate_num_distinct_values
+        ), name
+    # numstr was inferred Integral -> numeric profile exists with stats
+    ps = profiles_stream.profiles["numstr"]
+    assert ps.mean == pytest.approx(
+        profiles_mem.profiles["numstr"].mean, rel=1e-9
+    )
+    # cat is low-cardinality -> histogram present and equal
+    assert (
+        profiles_stream.profiles["cat"].histogram.values
+        == profiles_mem.profiles["cat"].histogram.values
+    )
+
+
+def test_empty_stream():
+    t = ColumnarTable.from_pydict({"x": [1.0, 2.0]}).head(0)
+    stream = stream_table(t)
+    ctx = AnalysisRunner.do_analysis_run(stream, [Size(), Completeness("x")])
+    assert ctx.metric_map[Size()].value.get() == 0.0
+
+
+def test_streaming_incremental_states(mixed_table):
+    """Streaming + save_states_with: states persisted from a streamed run
+    must merge with later batches exactly like materialized ones."""
+    from deequ_tpu.states import InMemoryStateProvider
+
+    half = mixed_table.num_rows // 2
+    first = mixed_table.filter_rows(np.arange(mixed_table.num_rows) < half)
+    second = mixed_table.filter_rows(np.arange(mixed_table.num_rows) >= half)
+
+    analyzers = [Size(), Mean("v"), Uniqueness(["id"])]
+    provider = InMemoryStateProvider()
+    AnalysisRunner.do_analysis_run(
+        stream_table(first, batch_rows=5_000), analyzers,
+        save_states_with=provider,
+    )
+    ctx = AnalysisRunner.do_analysis_run(
+        stream_table(second, batch_rows=5_000), analyzers,
+        aggregate_with=provider,
+    )
+    full = AnalysisRunner.do_analysis_run(mixed_table, analyzers)
+    for a in analyzers:
+        assert ctx.metric_map[a].value.get() == pytest.approx(
+            full.metric_map[a].value.get(), rel=1e-9
+        ), a
