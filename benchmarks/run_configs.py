@@ -129,9 +129,18 @@ def config4(n_rows: int):
     warm = ColumnarTable(
         [Column("key", DType.STRING, codes=warm_codes, dictionary=dictionary)]
     )
+    try:
+        warm.persist()
+    except MemoryError:
+        pass
     AnalysisRunner.do_analysis_run(warm, analyzers)
+    warm.unpersist()
     del warm
 
+    try:
+        table.persist()
+    except MemoryError:
+        pass
     t0 = time.time()
     ctx = AnalysisRunner.do_analysis_run(table, analyzers)
     wall = time.time() - t0

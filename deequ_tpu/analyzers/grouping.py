@@ -409,6 +409,49 @@ class Histogram(FrequencyBasedAnalyzer):
             str_freqs[key] = str_freqs.get(key, 0) + count
         return FrequenciesAndNumRows.from_dict((self.column,), str_freqs, total_count)
 
+    def calculate(self, table, aggregate_with=None, save_states_with=None):
+        # device top-N fast path: when nobody needs the mergeable frequency
+        # state and there is no binning UDF, counts are ranked ON DEVICE
+        # and only max_detail_bins (code, count) pairs are fetched/decoded —
+        # the engine-side top() of the reference (Histogram.scala:97-103).
+        # A high-cardinality column never materializes its groups on host.
+        if (
+            aggregate_with is None
+            and save_states_with is None
+            and self.binning_udf is None
+            and not getattr(table, "is_streaming", False)
+        ):
+            from deequ_tpu.analyzers.base import find_first_failing
+            from deequ_tpu.ops.segment import group_top_k
+
+            failing = find_first_failing(table.schema, self.preconditions())
+            if failing is not None:
+                return self.to_failure_metric(failing)
+            try:
+                stats = group_top_k(table, self.column, self.max_detail_bins)
+            except Exception as e:  # noqa: BLE001
+                from deequ_tpu.exceptions import wrap_if_necessary
+
+                return self.to_failure_metric(wrap_if_necessary(e))
+
+            def build_fast() -> Distribution:
+                # merge stringified collisions (e.g. 1 vs "1" -> "1") the
+                # same way the full path does
+                merged: Dict[str, int] = {}
+                for value, count in stats.top:
+                    key = _stringify(value)
+                    merged[key] = merged.get(key, 0) + count
+                details = {
+                    key: DistributionValue(count, count / stats.num_rows)
+                    for key, count in merged.items()
+                }
+                return Distribution(details, number_of_bins=stats.num_groups)
+
+            from deequ_tpu.tryresult import Try
+
+            return HistogramMetric(self.column, Try.of(build_fast))
+        return super().calculate(table, aggregate_with, save_states_with)
+
     def compute_metric_from(self, state: Optional[FrequenciesAndNumRows]) -> HistogramMetric:
         if state is None:
             return self.to_failure_metric(

@@ -67,6 +67,13 @@ def _compile_where(where: Optional[str], table: ColumnarTable):
     return compile_predicate(where, table)
 
 
+def _string_baked(table, cols) -> bool:
+    """True when a compiled predicate touches a string column: its
+    dictionary LUTs are baked into the trace at compile time, making the
+    program table-specific (excluded from cross-table program caches)."""
+    return any(c in table and table[c].dtype == DType.STRING for c in cols)
+
+
 def _rows(vals, row_valid, xp, n, predicate):
     if predicate is None:
         return row_valid
@@ -138,7 +145,10 @@ class Size(StandardScanAnalyzer):
         def update(vals, row_valid, xp, n):
             return {"n": xp.sum(_rows(vals, row_valid, xp, n, pred))}
 
-        return ScanOp(tuple(sorted(cols)), update, {"n": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"n": "sum"},
+            dictionary_baked=_string_baked(table, cols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[NumMatches]:
         return NumMatches(int(result["n"]))
@@ -157,8 +167,8 @@ class Completeness(StandardScanAnalyzer):
         return [has_column(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
 
         def update(vals, row_valid, xp, n):
@@ -166,7 +176,10 @@ class Completeness(StandardScanAnalyzer):
             matches = rows & _col_mask(vals[col], xp)
             return {"matches": xp.sum(matches), "count": xp.sum(rows)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"},
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[NumMatchesAndCount]:
         return NumMatchesAndCount(int(result["matches"]), int(result["count"]))
@@ -197,7 +210,10 @@ class Compliance(StandardScanAnalyzer):
             matches = rows & crit(vals, xp, n)
             return {"matches": xp.sum(matches), "count": xp.sum(rows)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"},
+            dictionary_baked=_string_baked(table, cols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[NumMatchesAndCount]:
         return NumMatchesAndCount(int(result["matches"]), int(result["count"]))
@@ -245,23 +261,28 @@ class PatternMatch(StandardScanAnalyzer):
         return [has_column(self.column), is_string(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
         rx = re.compile(self.pattern)
+        lut_kind = f"regex:{self.pattern}"
+
+        def build_lut(dictionary):
+            return np.array(
+                [rx.search(s) is not None for s in dictionary], dtype=np.bool_
+            )
 
         def update(vals, row_valid, xp, n):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
-            lut = np.array(
-                [rx.search(s) is not None for s in v.dictionary], dtype=np.bool_
-            )
-            if len(lut) == 0:
-                lut = np.zeros(1, dtype=np.bool_)
-            hit = xp.asarray(lut)[xp.maximum(v.data, 0)] & (v.data >= 0)
+            hit = v.lut(lut_kind)[xp.maximum(v.data, 0)] & (v.data >= 0)
             return {"matches": xp.sum(rows & hit), "count": xp.sum(rows)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"},
+            luts=((col, lut_kind, build_lut),),
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[NumMatchesAndCount]:
         return NumMatchesAndCount(int(result["matches"]), int(result["count"]))
@@ -276,8 +297,8 @@ class _ExtremumAnalyzer(StandardScanAnalyzer):
         return [has_column(self.column), is_numeric(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
         tag = self._tag
         identity = np.inf if tag == "min" else -np.inf
@@ -290,7 +311,10 @@ class _ExtremumAnalyzer(StandardScanAnalyzer):
             agg = xp.min(guarded) if tag == "min" else xp.max(guarded)
             return {"value": agg, "n": xp.sum(ok)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"value": tag, "n": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"value": tag, "n": "sum"},
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result):
         if int(result["n"]) == 0:
@@ -328,33 +352,36 @@ class _LengthAnalyzer(StandardScanAnalyzer):
         return [has_column(self.column), is_string(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
         tag = self._tag
         identity = np.inf if tag == "min" else -np.inf
 
-        def update(vals, row_valid, xp, n):
+        def build_lut(dictionary):
             from deequ_tpu import native
 
+            native_lengths = native.utf8_lengths(dictionary)
+            if native_lengths is not None:
+                return native_lengths.astype(np.float64)
+            return np.array(
+                [float(len(s)) for s in dictionary], dtype=np.float64
+            )
+
+        def update(vals, row_valid, xp, n):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
-            native_lengths = native.utf8_lengths(v.dictionary)
-            if native_lengths is not None:
-                lut = native_lengths.astype(np.float64)
-            else:
-                lut = np.array(
-                    [float(len(s)) for s in v.dictionary], dtype=np.float64
-                )
-            if len(lut) == 0:
-                lut = np.zeros(1, dtype=np.float64)
-            lengths = xp.asarray(lut)[xp.maximum(v.data, 0)]
+            lengths = v.lut("utf8len")[xp.maximum(v.data, 0)]
             ok = rows & (v.data >= 0)
             guarded = xp.where(ok, lengths, identity)
             agg = xp.min(guarded) if tag == "min" else xp.max(guarded)
             return {"value": agg, "n": xp.sum(ok)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"value": tag, "n": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"value": tag, "n": "sum"},
+            luts=((col, "utf8len", build_lut),),
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result):
         if int(result["n"]) == 0:
@@ -392,8 +419,8 @@ class Mean(StandardScanAnalyzer):
         return [has_column(self.column), is_numeric(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
 
         def update(vals, row_valid, xp, n):
@@ -402,7 +429,10 @@ class Mean(StandardScanAnalyzer):
             ok = rows & v.mask
             return {"sum": xp.sum(xp.where(ok, v.data, 0.0)), "count": xp.sum(ok)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"sum": "sum", "count": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"sum": "sum", "count": "sum"},
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[MeanState]:
         if int(result["count"]) == 0:
@@ -421,8 +451,8 @@ class Sum(StandardScanAnalyzer):
         return [has_column(self.column), is_numeric(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
 
         def update(vals, row_valid, xp, n):
@@ -431,7 +461,10 @@ class Sum(StandardScanAnalyzer):
             ok = rows & v.mask
             return {"sum": xp.sum(xp.where(ok, v.data, 0.0)), "n": xp.sum(ok)}
 
-        return ScanOp(tuple(sorted(cols)), update, {"sum": "sum", "n": "sum"})
+        return ScanOp(
+            tuple(sorted(cols)), update, {"sum": "sum", "n": "sum"},
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[SumState]:
         if int(result["n"]) == 0:
@@ -466,8 +499,8 @@ class StandardDeviation(StandardScanAnalyzer):
         return [has_column(self.column), is_numeric(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
 
         def update(vals, row_valid, xp, n):
@@ -475,7 +508,9 @@ class StandardDeviation(StandardScanAnalyzer):
             return {"n": cnt, "avg": mean, "m2": m2}
 
         return ScanOp(
-            tuple(sorted(cols)), update, {"n": "gather", "avg": "gather", "m2": "gather"}
+            tuple(sorted(cols)), update,
+            {"n": "gather", "avg": "gather", "m2": "gather"},
+            dictionary_baked=_string_baked(table, wcols),
         )
 
     def state_from_scan_result(self, result) -> Optional[StandardDeviationState]:
@@ -519,8 +554,8 @@ class Correlation(StandardScanAnalyzer):
         ]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.first_column, self.second_column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.first_column, self.second_column}
         ca, cb = self.first_column, self.second_column
 
         def update(vals, row_valid, xp, n):
@@ -545,7 +580,10 @@ class Correlation(StandardScanAnalyzer):
             }
 
         tags = {k: "gather" for k in ("n", "x_avg", "y_avg", "ck", "x_mk", "y_mk")}
-        return ScanOp(tuple(sorted(cols)), update, tags)
+        return ScanOp(
+            tuple(sorted(cols)), update, tags,
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[CorrelationState]:
         fields = ["n", "x_avg", "y_avg", "ck", "x_mk", "y_mk"]
@@ -615,8 +653,8 @@ class DataType(ScanShareableAnalyzer):
         return [has_column(self.column)]
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
         dtype = table[col].dtype
 
@@ -624,11 +662,10 @@ class DataType(ScanShareableAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             if dtype == DType.STRING:
-                lut = _classify_dictionary(v.dictionary)
-                if len(lut) == 0:
-                    lut = np.zeros(1, dtype=np.int32)
                 classes = xp.where(
-                    v.data >= 0, xp.asarray(lut)[xp.maximum(v.data, 0)], 0
+                    v.data >= 0,
+                    v.lut("datatype")[xp.maximum(v.data, 0)],
+                    0,
                 )
             else:
                 const = {
@@ -642,7 +679,16 @@ class DataType(ScanShareableAnalyzer):
             )
             return {"counts": counts}
 
-        return ScanOp(tuple(sorted(cols)), update, {"counts": "sum"})
+        luts = (
+            ((col, "datatype", _classify_dictionary),)
+            if dtype == DType.STRING
+            else ()
+        )
+        return ScanOp(
+            tuple(sorted(cols)), update, {"counts": "sum"},
+            luts=luts,
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[DataTypeHistogram]:
         c = np.asarray(result["counts"]).astype(np.int64)

@@ -6,9 +6,10 @@ register file (elementwise-max monoid, exactly the reference's register-max
 merge, StatefulHyperloglogPlus.scala:121-139), which the engine merges with
 the ``max`` collective across devices.
 
-KLLSketch runs as an extra pass over streamed chunks (the analogue of the
-reference's KLLRunner mapPartitions + treeReduce bypass,
-analyzers/runners/KLLRunner.scala:87-179).
+KLLSketch and ApproxQuantile(s) are scan-shareable: the sketch is built ON
+DEVICE inside the shared fused pass (per-chunk sort + deterministic strata
+compaction, ops/kll_device.py) — one pass covers everything, whereas the
+reference needs a separate KLL job (KLLRunner.scala:87-179).
 
 ApproxQuantile(s): the reference uses Spark's GK percentile digest
 (StatefulApproxQuantile). Here both are backed by the same KLL sketch —
@@ -96,10 +97,10 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
         return self.column
 
     def scan_op(self, table: ColumnarTable) -> ScanOp:
-        from deequ_tpu.analyzers.scan import _compile_where, _rows
+        from deequ_tpu.analyzers.scan import _compile_where, _rows, _string_baked
 
-        pred, cols = _compile_where(self.where, table)
-        cols = cols | {self.column}
+        pred, wcols = _compile_where(self.where, table)
+        cols = wcols | {self.column}
         col = self.column
         dtype = table[col].dtype
         p = hll_ops.precision_from_relative_sd()
@@ -108,10 +109,7 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             if dtype == DType.STRING:
-                lut = hll_ops.hash_strings(v.dictionary)
-                if len(lut) == 0:
-                    lut = np.zeros(1, dtype=np.uint64)
-                hashes = xp.asarray(lut)[xp.maximum(v.data, 0)]
+                hashes = v.lut("xxhash64")[xp.maximum(v.data, 0)]
                 valid = rows & (v.data >= 0)
             elif dtype == DType.BOOLEAN:
                 hashes = hll_ops.splitmix64(
@@ -124,7 +122,16 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
             regs = hll_ops.registers_from_hashes(hashes, valid, p, xp)
             return {"registers": regs}
 
-        return ScanOp(tuple(sorted(cols)), update, {"registers": "max"})
+        luts = (
+            ((col, "xxhash64", hll_ops.hash_strings),)
+            if dtype == DType.STRING
+            else ()
+        )
+        return ScanOp(
+            tuple(sorted(cols)), update, {"registers": "max"},
+            luts=luts,
+            dictionary_baked=_string_baked(table, wcols),
+        )
 
     def state_from_scan_result(self, result) -> Optional[ApproxCountDistinctState]:
         regs = np.asarray(result["registers"]).astype(np.int64)
@@ -206,10 +213,12 @@ def _sketch_column(
     shrinking_factor: float,
     where_mask: Optional[np.ndarray] = None,
 ) -> Optional[KLLState]:
-    """The KLL extra pass: partition the rows, build one sketch per
-    partition in a thread pool (numpy's sort/compress release the GIL, so
-    this is real parallelism — the mapPartitions analogue of
-    KLLRunner.scala:104-112), then merge pairwise in a tree (treeReduce).
+    """HOST reference implementation of the partitioned KLL pass
+    (mapPartitions + treeReduce analogue, KLLRunner.scala:104-112): one
+    sketch per partition in a thread pool, then a pairwise tree merge.
+    The production path builds sketches on device inside the fused scan
+    (_kll_scan_op); this host path pins the sketch algebra in tests and
+    serves as a device-free fallback.
 
     ``where_mask`` fuses a predicate into the pass (no filtered table
     copy is ever materialized).
@@ -258,10 +267,68 @@ def _sketch_column(
     return KLLState(sketch, global_min, global_max)
 
 
+
+def _kll_scan_op(
+    table: ColumnarTable,
+    column: str,
+    sketch_size: int,
+    where: Optional[str] = None,
+) -> ScanOp:
+    """Device KLL summary as a fused-scan op: sort the chunk, compact to
+    strata midpoints + exact remainder (ops/kll_device.py), gather the
+    tiny weighted summary. Quantile sketching shares the ONE compiled
+    pass with every other scan-shareable analyzer — no extra pass over
+    the data, unlike the reference's separate KLL job
+    (KLLRunner.scala:87-179)."""
+    from deequ_tpu.analyzers.scan import _compile_where, _rows, _string_baked
+    from deequ_tpu.ops.kll_device import chunk_summary
+
+    pred, wcols = _compile_where(where, table)
+    cols = wcols | {column}
+    col = column
+
+    def update(vals, row_valid, xp, n):
+        rows = _rows(vals, row_valid, xp, n, pred)
+        v = vals[col]
+        valid = rows & v.mask
+        return chunk_summary(v.data, valid, sketch_size, n, xp)
+
+    tags = {
+        "items": "gather",
+        "weights": "gather",
+        "count": "sum",
+        "min": "min",
+        "max": "max",
+    }
+    return ScanOp(
+        tuple(sorted(cols)), update, tags,
+        dictionary_baked=_string_baked(table, wcols),
+    )
+
+
+def _kll_state_from_result(
+    result, sketch_size: int, shrinking_factor: float
+) -> Optional[KLLState]:
+    from deequ_tpu.ops.kll_device import fold_summaries
+
+    count = int(np.asarray(result["count"]))
+    if count == 0:
+        return None
+    sketch = fold_summaries(
+        result["items"], result["weights"], sketch_size, shrinking_factor
+    )
+    if sketch is None:
+        return None
+    return KLLState(
+        sketch, float(np.asarray(result["min"])), float(np.asarray(result["max"]))
+    )
+
+
 @dataclass(frozen=True)
-class KLLSketch(Analyzer):
+class KLLSketch(ScanShareableAnalyzer):
     """KLL quantile sketch -> equi-width BucketDistribution
-    (reference analyzers/KLLSketch.scala:90-176)."""
+    (reference analyzers/KLLSketch.scala:90-176). Scan-shareable: the
+    sketch is built on device inside the shared fused pass."""
 
     column: str
     kll_parameters: Optional[KLLParameters] = None
@@ -269,6 +336,10 @@ class KLLSketch(Analyzer):
     @property
     def params(self) -> KLLParameters:
         return self.kll_parameters or KLLParameters()
+
+    @property
+    def instance(self) -> str:
+        return self.column
 
     def preconditions(self):
         def param_check(schema):
@@ -280,12 +351,12 @@ class KLLSketch(Analyzer):
 
         return [param_check, has_column(self.column), is_numeric(self.column)]
 
-    def compute_state_from(self, table: ColumnarTable) -> Optional[KLLState]:
-        p = self.params
-        return _sketch_column(table, self.column, p.sketch_size, p.shrinking_factor)
+    def scan_op(self, table: ColumnarTable) -> ScanOp:
+        return _kll_scan_op(table, self.column, self.params.sketch_size)
 
-    def _stream_columns(self):
-        return [self.column]
+    def state_from_scan_result(self, result) -> Optional[KLLState]:
+        p = self.params
+        return _kll_state_from_result(result, p.sketch_size, p.shrinking_factor)
 
     def compute_metric_from(self, state: Optional[KLLState]) -> KLLMetric:
         if state is None:
@@ -323,86 +394,23 @@ def _sketch_size_for_error(relative_error: float) -> int:
     return max(256, int(2.3 / max(relative_error, 1e-6)))
 
 
-def _device_exact_quantiles(table, column: str, qs) -> Optional[tuple]:
-    """EXACT quantiles via a device sort over a persisted table's HBM
-    buffers — the TPU-first fast path for ApproxQuantile(s) when no
-    mergeable sketch state is needed.
-
-    The sketch exists to make quantiles mergeable across partitions and
-    incremental runs (KLLRunner.scala's whole reason to exist). When the
-    column is already device-resident and the caller needs only the metric,
-    a single XLA sort is both faster and exact — any relative_error bound
-    is trivially satisfied. Returns (values_for_qs, valid_count) or None
-    if the fast path doesn't apply.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    cache = getattr(table, "_device_cache", None)
-    if cache is None or not cache.device_chunks:
-        return None
-    packer = cache.packer
-    if column in packer.wide_names:
-        src, row = "wide", packer.wide_names.index(column)
-    elif column in packer.narrow_i32:
-        src, row = "narrow_i", packer.narrow_i32.index(column)
-    elif column in packer.narrow_f32:
-        src, row = "narrow_f", packer.narrow_f32.index(column)
-    else:
-        return None  # string column
-    mask_row = packer._mask_row.get(column)
-
-    prog_key = ("exact_quantiles", column, tuple(qs), len(cache.device_chunks))
-    fn = cache.get_program(prog_key)
-    if fn is None:
-
-        def kernel(*chunks):
-            parts = []
-            masks_ = []
-            for (values, narrow_i, narrow_f, masks, codes, row_valid) in chunks:
-                buf = {"wide": values, "narrow_i": narrow_i,
-                       "narrow_f": narrow_f}[src][row]
-                parts.append(buf.astype(jnp.float64))
-                masks_.append(
-                    masks[mask_row] & row_valid
-                    if mask_row is not None
-                    else row_valid
-                )
-            v = jnp.concatenate(parts)
-            m = jnp.concatenate(masks_)
-            count = m.sum()
-            sv = jnp.sort(jnp.where(m, v, jnp.inf))
-            # SAME rank rule as the KLL sketch path (searchsorted-left over
-            # cumulative weights, KLLSketchState.quantile): on exact data
-            # that rule selects index ceil(q*n)-1, so persisted and
-            # streaming runs agree on identical data — the reference's
-            # incremental==batch metric-equality invariant
-            # (IncrementalAnalysisTest.scala:30-90)
-            idx = jnp.clip(
-                jnp.ceil(jnp.asarray(qs) * count) - 1,
-                0, jnp.maximum(count - 1, 0),
-            ).astype(jnp.int32)
-            return sv[idx], count
-
-        fn = jax.jit(lambda *chunks: kernel(*chunks))
-        cache.put_program(prog_key, fn)
-
-    values, count = fn(*[tuple(c) for c in cache.device_chunks])
-    count = int(count)
-    if count == 0:
-        return None
-    return np.asarray(values), count
-
-
 @dataclass(frozen=True)
-class ApproxQuantile(Analyzer):
+class ApproxQuantile(ScanShareableAnalyzer):
     """Single approximate quantile (reference analyzers/ApproxQuantile.scala).
-    KLL-backed (design deviation documented in the module docstring)."""
+    KLL-backed (design deviation documented in the module docstring); built
+    on device inside the shared fused pass. The SAME sketch path runs for
+    every table residency (in-memory, persisted, streaming), so identical
+    data always yields the identical metric — the reference's
+    incremental==batch invariant (IncrementalAnalysisTest.scala:30-90)."""
 
     column: str
     quantile: float
     relative_error: float = 0.01
     where: Optional[str] = None
+
+    @property
+    def instance(self) -> str:
+        return self.column
 
     def preconditions(self):
         def param_check(schema):
@@ -417,27 +425,18 @@ class ApproxQuantile(Analyzer):
 
         return [param_check, has_column(self.column), is_numeric(self.column)]
 
-    def compute_state_from(self, table: ColumnarTable) -> Optional[KLLState]:
-        where_mask = None
-        if self.where is not None:
-            from deequ_tpu.expr.eval import eval_predicate_on_table
-
-            # fused predicate: a boolean mask, not a filtered table copy
-            where_mask = np.asarray(
-                eval_predicate_on_table(self.where, table), dtype=bool
-            )
-        return _sketch_column(
+    def scan_op(self, table: ColumnarTable) -> ScanOp:
+        return _kll_scan_op(
             table, self.column,
-            _sketch_size_for_error(self.relative_error), DEFAULT_SHRINKING_FACTOR,
-            where_mask=where_mask,
+            _sketch_size_for_error(self.relative_error), self.where,
         )
 
-    def _stream_columns(self):
-        if self.where is None:
-            return [self.column]
-        from deequ_tpu.expr.parser import parse_expression
-
-        return sorted({self.column} | parse_expression(self.where).columns())
+    def state_from_scan_result(self, result) -> Optional[KLLState]:
+        return _kll_state_from_result(
+            result,
+            _sketch_size_for_error(self.relative_error),
+            DEFAULT_SHRINKING_FACTOR,
+        )
 
     def compute_metric_from(self, state: Optional[KLLState]) -> DoubleMetric:
         if state is None:
@@ -447,30 +446,6 @@ class ApproxQuantile(Analyzer):
         value = state.sketch.quantile(self.quantile)
         return metric_from_value(value, "ApproxQuantile", self.column, Entity.COLUMN)
 
-    def calculate(self, table, aggregate_with=None, save_states_with=None):
-        # persisted table + no mergeable state needed -> exact device sort
-        # (see _device_exact_quantiles); otherwise the KLL sketch path
-        if (
-            aggregate_with is None
-            and save_states_with is None
-            and self.where is None
-        ):
-            from deequ_tpu.analyzers.base import find_first_failing
-
-            failing = find_first_failing(table.schema, self.preconditions())
-            if failing is not None:
-                return self.to_failure_metric(failing)
-            try:
-                fast = _device_exact_quantiles(table, self.column, (self.quantile,))
-            except Exception as e:  # noqa: BLE001
-                return self.to_failure_metric(wrap_if_necessary(e))
-            if fast is not None:
-                values, _count = fast
-                return metric_from_value(
-                    float(values[0]), "ApproxQuantile", self.column, Entity.COLUMN
-                )
-        return super().calculate(table, aggregate_with, save_states_with)
-
     def to_failure_metric(self, exception: Exception) -> DoubleMetric:
         return metric_from_failure(
             exception, "ApproxQuantile", self.column, Entity.COLUMN
@@ -478,7 +453,7 @@ class ApproxQuantile(Analyzer):
 
 
 @dataclass(frozen=True)
-class ApproxQuantiles(Analyzer):
+class ApproxQuantiles(ScanShareableAnalyzer):
     """Many quantiles from one sketch -> KeyedDoubleMetric
     (reference analyzers/ApproxQuantiles.scala:39-101)."""
 
@@ -490,6 +465,10 @@ class ApproxQuantiles(Analyzer):
         object.__setattr__(self, "column", column)
         object.__setattr__(self, "quantiles", tuple(quantiles))
         object.__setattr__(self, "relative_error", relative_error)
+
+    @property
+    def instance(self) -> str:
+        return self.column
 
     def preconditions(self):
         def param_check(schema):
@@ -505,14 +484,17 @@ class ApproxQuantiles(Analyzer):
 
         return [param_check, has_column(self.column), is_numeric(self.column)]
 
-    def compute_state_from(self, table: ColumnarTable) -> Optional[KLLState]:
-        return _sketch_column(
-            table, self.column,
-            _sketch_size_for_error(self.relative_error), DEFAULT_SHRINKING_FACTOR,
+    def scan_op(self, table: ColumnarTable) -> ScanOp:
+        return _kll_scan_op(
+            table, self.column, _sketch_size_for_error(self.relative_error)
         )
 
-    def _stream_columns(self):
-        return [self.column]
+    def state_from_scan_result(self, result) -> Optional[KLLState]:
+        return _kll_state_from_result(
+            result,
+            _sketch_size_for_error(self.relative_error),
+            DEFAULT_SHRINKING_FACTOR,
+        )
 
     def compute_metric_from(self, state: Optional[KLLState]) -> KeyedDoubleMetric:
         if state is None:
@@ -525,27 +507,6 @@ class ApproxQuantiles(Analyzer):
         return KeyedDoubleMetric(
             Entity.COLUMN, "ApproxQuantiles", self.column, Success(values)
         )
-
-    def calculate(self, table, aggregate_with=None, save_states_with=None):
-        if aggregate_with is None and save_states_with is None:
-            from deequ_tpu.analyzers.base import find_first_failing
-
-            failing = find_first_failing(table.schema, self.preconditions())
-            if failing is not None:
-                return self.to_failure_metric(failing)
-            try:
-                fast = _device_exact_quantiles(table, self.column, self.quantiles)
-            except Exception as e:  # noqa: BLE001
-                return self.to_failure_metric(wrap_if_necessary(e))
-            if fast is not None:
-                values, _count = fast
-                keyed = {
-                    str(q): float(v) for q, v in zip(self.quantiles, values)
-                }
-                return KeyedDoubleMetric(
-                    Entity.COLUMN, "ApproxQuantiles", self.column, Success(keyed)
-                )
-        return super().calculate(table, aggregate_with, save_states_with)
 
     def to_failure_metric(self, exception: Exception) -> KeyedDoubleMetric:
         return KeyedDoubleMetric(

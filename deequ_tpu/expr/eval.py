@@ -55,6 +55,18 @@ class Val:
     data: Any = None
     mask: Any = None
     dictionary: Optional[np.ndarray] = None
+    # device-resident lookup tables keyed by kind (engine-provided jit
+    # ARGUMENTS, not trace-time constants — see ops/lut_cache.py); ops
+    # declare the tables they need via ScanOp.luts
+    luts: Optional[Dict[str, Any]] = None
+
+    def lut(self, kind: str):
+        if self.luts is None or kind not in self.luts:
+            raise KeyError(
+                f"lut {kind!r} was not provided for this column; declare it "
+                f"in ScanOp.luts"
+            )
+        return self.luts[kind]
 
 
 def _and_masks(xp, *masks):

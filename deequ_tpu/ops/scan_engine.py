@@ -90,6 +90,17 @@ class ScanOp:
     # seconds of host Python — the analogue of Spark reusing a compiled
     # whole-stage-codegen plan)
     cache_key: Any = None
+    # dictionary-derived lookup tables this op needs, as (column, kind,
+    # builder(dictionary)->np.ndarray): the engine builds them (memoized per
+    # dictionary), pads to pow2, transfers ONCE, and passes them to the
+    # jitted step as arguments — update reads vals[col].lut(kind). Programs
+    # whose only dictionary dependence goes through luts stay reusable
+    # across tables/batches.
+    luts: Tuple[Tuple[str, str, Callable], ...] = ()
+    # True when update reads v.dictionary directly at trace time (e.g. a
+    # where-predicate comparing string literals) — such programs bake
+    # table-specific constants and are excluded from cross-table caches
+    dictionary_baked: bool = False
 
 
 class ScanStats:
@@ -275,7 +286,8 @@ class _ChunkPacker:
         return values, narrow_i, narrow_f, masks, codes, row_valid
 
     def unpack_vals(
-        self, values, narrow_i, narrow_f, masks, codes, xp, row_valid=None
+        self, values, narrow_i, narrow_f, masks, codes, xp, row_valid=None,
+        col_luts=None,
     ) -> Dict[str, Val]:
         """Slice the packed buffers back into per-column Vals (inside jit)."""
         vals: Dict[str, Val] = {}
@@ -300,7 +312,8 @@ class _ChunkPacker:
                 vals[name] = Val("num", data, mask)
         for j, name in enumerate(self.string_names):
             vals[name] = Val(
-                "str", codes[j], None, dictionary=self.col_dict[name]
+                "str", codes[j], None, dictionary=self.col_dict[name],
+                luts=(col_luts or {}).get(name),
             )
         return vals
 
@@ -511,7 +524,12 @@ def _make_put(mesh):
     return put
 
 
-def _build_step_fns(ops, unpacker, mesh, local_n):
+def _split_lut_key(key: str) -> Tuple[str, str]:
+    col, _, kind = key.partition("\x00")
+    return col, kind
+
+
+def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()):
     """Build (jitted flat step fn, shape fn) for one packer layout.
 
     The flat step computes every op's partial state for one packed chunk,
@@ -519,11 +537,17 @@ def _build_step_fns(ops, unpacker, mesh, local_n):
     leaves into ONE f64 vector: device->host fetches over the TPU tunnel pay
     ~0.1s latency PER BUFFER, and a fused scan easily produces hundreds of
     small state leaves (f64 is lossless for all state leaves: counts < 2^53,
-    registers i32)."""
+    registers i32). ``lut_keys`` names the dictionary LUTs passed as an
+    extra dict argument (replicated across the mesh)."""
 
-    def step(values, narrow_i, narrow_f, masks, codes, row_valid):
+    def step(values, narrow_i, narrow_f, masks, codes, row_valid, luts):
+        col_luts: Dict[str, Dict[str, Any]] = {}
+        for key, arr in luts.items():
+            col, kind = _split_lut_key(key)
+            col_luts.setdefault(col, {})[kind] = arr
         vals = unpacker.unpack_vals(
-            values, narrow_i, narrow_f, masks, codes, jnp, row_valid
+            values, narrow_i, narrow_f, masks, codes, jnp, row_valid,
+            col_luts=col_luts,
         )
         partials = tuple(op.update(vals, row_valid, jnp, local_n) for op in ops)
         if mesh is not None:
@@ -556,18 +580,23 @@ def _build_step_fns(ops, unpacker, mesh, local_n):
                 P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
                 P(None, ROW_AXIS), P(None, ROW_AXIS),
                 P(ROW_AXIS),
+                {key: P() for key in lut_keys},
             ),
             out_specs=P(),
             check_vma=False,
         )
 
-        def flat_outer(values, narrow_i, narrow_f, masks, codes, row_valid):
-            return _flatten(inner(values, narrow_i, narrow_f, masks, codes, row_valid))
+        def flat_outer(values, narrow_i, narrow_f, masks, codes, row_valid, luts):
+            return _flatten(
+                inner(values, narrow_i, narrow_f, masks, codes, row_valid, luts)
+            )
 
         return jax.jit(flat_outer), inner
 
-    def flat_single(values, narrow_i, narrow_f, masks, codes, row_valid):
-        return _flatten(step(values, narrow_i, narrow_f, masks, codes, row_valid))
+    def flat_single(values, narrow_i, narrow_f, masks, codes, row_valid, luts):
+        return _flatten(
+            step(values, narrow_i, narrow_f, masks, codes, row_valid, luts)
+        )
 
     return jax.jit(flat_single), step
 
@@ -583,12 +612,40 @@ def _unflatten_partials(flat: np.ndarray, shapes):
     return jax.tree.unflatten(jax.tree.structure(shapes), leaves)
 
 
-def _ops_prog_key(ops, chunk):
+def _collect_luts(ops, dictionaries: Dict[str, Any], mesh) -> Dict[str, Any]:
+    """Build (memoized) + device-put every dictionary LUT the ops declare.
+    Returns {"col\\x00kind": device_array}."""
+    from deequ_tpu.ops.lut_cache import dictionary_lut_device
+
+    lut_arrays: Dict[str, Any] = {}
+    for op in ops:
+        for col, kind, builder in op.luts:
+            key = col + "\x00" + kind
+            if key in lut_arrays:
+                continue
+            lut_arrays[key] = dictionary_lut_device(
+                dictionaries[col], kind, builder, mesh
+            )
+    return lut_arrays
+
+
+def _lut_sig(lut_arrays: Dict[str, Any]):
+    """Shape/dtype signature of the LUT argument set (part of the program
+    identity — content is a runtime input, shape is compile-time)."""
+    return tuple(
+        sorted(
+            (key, int(arr.shape[0]), str(arr.dtype))
+            for key, arr in lut_arrays.items()
+        )
+    )
+
+
+def _ops_prog_key(ops, chunk, lut_sig=()):
     """Hashable identity of the fused program, or None if any op opted out."""
     if not all(op.cache_key is not None for op in ops):
         return None
     try:
-        key = (tuple(op.cache_key for op in ops), chunk)
+        key = (tuple(op.cache_key for op in ops), chunk, lut_sig)
         hash(key)
         return key
     except TypeError:
@@ -604,17 +661,19 @@ def _mesh_key(mesh):
 
 
 def _global_prog_key(prog_key, packer, dtypes, mesh):
-    """Key for the cross-table streaming program cache. Only
-    table-INDEPENDENT programs are cacheable: ops over string columns bake
-    per-table dictionary LUTs into the trace as constants, so any string
-    column disables the cache."""
-    if prog_key is None or packer.string_names:
+    """Key for the cross-table program cache. Only table-INDEPENDENT
+    programs are cacheable: string ops that route their dictionary
+    dependence through LUT arguments qualify; an op that reads the
+    dictionary at trace time (dictionary_baked) bakes per-table constants
+    and disables the cache (checked by the caller)."""
+    if prog_key is None:
         return None
     layout = (
         tuple(packer.wide_names),
         tuple(packer.narrow_i32),
         tuple(packer.narrow_f32),
         tuple(packer.masked_names),
+        tuple(packer.string_names),
         tuple((name, dtypes[name]) for name in packer.numeric_names),
     )
     return (prog_key, layout, _mesh_key(mesh))
@@ -680,18 +739,28 @@ def run_scan(
         packer = _ChunkPacker(cols, chunk)
     local_n = chunk // n_dev if mesh is not None else chunk
 
+    # dictionary LUTs ship once (memoized device arrays) and enter the
+    # jitted step as arguments
+    lut_arrays = _collect_luts(
+        ops, {n: packer.col_dict.get(n) for n in packer.string_names}, mesh
+    )
+    lut_sig = _lut_sig(lut_arrays)
+    baked = any(op.dictionary_baked for op in ops)
+
     # reuse the traced program across repeated runs: per-table cache for
-    # persisted tables; global cache for streaming same-schema batches
-    prog_key = _ops_prog_key(ops, chunk)
-    global_key = None
+    # persisted tables, plus the global cache for any program without
+    # trace-baked dictionary constants (resident and streamed runs over
+    # same-schema tables share one traced program)
+    prog_key = _ops_prog_key(ops, chunk, lut_sig)
+    dtypes = {n: c.dtype for n, c in cols.items()}
+    global_key = (
+        _global_prog_key(prog_key, packer, dtypes, mesh) if not baked else None
+    )
     cached_prog = None
     if cache is not None and prog_key is not None:
         cached_prog = cache.get_program(prog_key)
-    elif cache is None:
-        dtypes = {n: c.dtype for n, c in cols.items()}
-        global_key = _global_prog_key(prog_key, packer, dtypes, mesh)
-        if global_key is not None:
-            cached_prog = _GLOBAL_PROGRAMS.get(global_key)
+    if cached_prog is None and global_key is not None:
+        cached_prog = _GLOBAL_PROGRAMS.get(global_key)
 
     if cached_prog is not None:
         step_fn, shapes0 = cached_prog
@@ -702,7 +771,10 @@ def run_scan(
         SCAN_STATS.programs_built += 1
         # the trace closure captures a metadata-only view, never the column
         # arrays — cached programs must not pin batches in host memory
-        step_fn, shape_fn = _build_step_fns(ops, packer.unpack_view(), mesh, local_n)
+        step_fn, shape_fn = _build_step_fns(
+            ops, packer.unpack_view(), mesh, local_n,
+            tuple(sorted(lut_arrays)),
+        )
 
     SCAN_STATS.scan_passes += 1
     SCAN_STATS.rows_scanned += n_rows
@@ -727,10 +799,12 @@ def run_scan(
         SCAN_STATS.bytes_resident += cache.nbytes
         for args in cache.device_chunks:
             if folder.shapes is None:
-                folder.shapes = jax.eval_shape(shape_fn, *args)
+                folder.shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
                 if prog_key is not None:
                     cache.put_program(prog_key, (step_fn, folder.shapes))
-            in_flight.append(step_fn(*args))
+                if global_key is not None:
+                    _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
+            in_flight.append(step_fn(*args, lut_arrays))
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
     else:
@@ -740,10 +814,10 @@ def run_scan(
             args = packer.pack(start, stop)
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
             if folder.shapes is None:
-                folder.shapes = jax.eval_shape(shape_fn, *args)
+                folder.shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
                 if global_key is not None:
                     _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
-            in_flight.append(step_fn(*put(args)))
+            in_flight.append(step_fn(*put(args), lut_arrays))
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
     for device_result in in_flight:
@@ -877,8 +951,7 @@ def _run_scan_stream(
     chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
     local_n = chunk // n_dev if mesh is not None else chunk
     put = _make_put(mesh)
-    prog_key = _ops_prog_key(ops, chunk)
-    has_strings = any(dtypes[n] == DType.STRING for n in needed)
+    baked = any(op.dictionary_baked for op in ops)
 
     SCAN_STATS.scan_passes += 1
 
@@ -886,9 +959,10 @@ def _run_scan_stream(
     in_flight = []
     window = 3
     layout: Optional[dict] = None
-    # the current layout's (step_fn, shapes); reset on a layout upgrade
-    # (upgrades are sticky, so superseded layouts never recur)
-    current_prog: Optional[tuple] = None
+    # the current (layout, lut signature)'s (step_fn, shapes); reset when
+    # either changes (layout upgrades are sticky; LUT shapes change only
+    # when a batch dictionary crosses a pow2 size bucket)
+    current_prog: Optional[tuple] = None  # (sig, step_fn, shapes)
 
     import time as _time
 
@@ -905,12 +979,22 @@ def _run_scan_stream(
                 current_prog = None
         packer = _ChunkPacker(cols, chunk, layout=layout)
 
+        lut_arrays = _collect_luts(
+            ops, {c: packer.col_dict.get(c) for c in packer.string_names}, mesh
+        )
+        lut_sig = _lut_sig(lut_arrays)
+        prog_key = _ops_prog_key(ops, chunk, lut_sig)
+        sig = (tuple(sorted(layout.items())), lut_sig)
+
         prog = None
-        global_key = _global_prog_key(prog_key, packer, dtypes, mesh)
+        global_key = (
+            _global_prog_key(prog_key, packer, dtypes, mesh) if not baked else None
+        )
         if global_key is not None:
             prog = _GLOBAL_PROGRAMS.get(global_key)
-        if prog is None and not has_strings:
-            prog = current_prog
+        if prog is None and not baked:
+            if current_prog is not None and current_prog[0] == sig:
+                prog = current_prog[1:]
 
         if prog is not None:
             step_fn, shapes = prog
@@ -919,7 +1003,8 @@ def _run_scan_stream(
         else:
             SCAN_STATS.programs_built += 1
             step_fn, shape_fn = _build_step_fns(
-                ops, packer.unpack_view(), mesh, local_n
+                ops, packer.unpack_view(), mesh, local_n,
+                tuple(sorted(lut_arrays)),
             )
             shapes = None
 
@@ -928,14 +1013,14 @@ def _run_scan_stream(
             args = packer.pack(start, stop)
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
             if shapes is None:
-                shapes = jax.eval_shape(shape_fn, *args)
-                if not has_strings:
-                    current_prog = (step_fn, shapes)
+                shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
+                if not baked:
+                    current_prog = (sig, step_fn, shapes)
                     if global_key is not None:
                         _GLOBAL_PROGRAMS.put(global_key, (step_fn, shapes))
             if folder.shapes is None:
                 folder.shapes = shapes
-            in_flight.append(step_fn(*put(args)))
+            in_flight.append(step_fn(*put(args), lut_arrays))
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
             if stop >= n:

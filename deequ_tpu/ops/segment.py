@@ -130,6 +130,148 @@ def column_key_codes(col: Column) -> Tuple[np.ndarray, List]:
     return codes, values
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
+def _bincount_fn(num_segments: int, mesh):
+    """Jitted (and mesh-wrapped) bincount kernel, cached so repeated runs
+    with the same cardinality/mesh reuse the traced program instead of
+    retracing per call."""
+
+    def count(k):
+        slot = jnp.where(k < 0, num_segments, k)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(slot, dtype=jnp.int64), slot, num_segments=num_segments + 1
+        )
+        if mesh is not None:
+            counts = jax.lax.psum(counts, ROW_AXIS)
+        return counts
+
+    if mesh is not None:
+        return jax.jit(
+            jax.shard_map(count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+        )
+    return jax.jit(count)
+
+
+@lru_cache(maxsize=64)
+def _topk_fn(num_segments: int, kk: int, mesh, merge_null_into: int = -1):
+    """Jitted dense-count + device top-k kernel (cached like _bincount_fn).
+    ``merge_null_into`` as in _topk_from_counts_fn."""
+
+    def kernel(c):
+        slot = jnp.where(c < 0, num_segments, c)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(slot, dtype=jnp.int64), slot,
+            num_segments=num_segments + 1,
+        )
+        if mesh is not None:
+            counts = jax.lax.psum(counts, ROW_AXIS)
+        counts = counts[:num_segments]
+        if merge_null_into >= 0:
+            counts = counts.at[merge_null_into].add(counts[0])
+            counts = counts.at[0].set(0)
+        num_groups = (counts > 0).sum()
+        top_counts, top_idx = jax.lax.top_k(counts, kk)
+        return num_groups, top_counts, top_idx
+
+    if mesh is not None:
+        return jax.jit(
+            jax.shard_map(kernel, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+        )
+    return jax.jit(kernel)
+
+
+# -- device-resident grouping (persisted tables) ----------------------------
+#
+# When the table is persist()ed, a string column's codes already live in
+# HBM inside the packed chunks; the grouping kernels then read them there
+# instead of re-shipping O(rows) host bytes per analysis run. Only the tiny
+# counts-derived results (top-k bins, scalar stats) ever leave the device.
+
+
+@lru_cache(maxsize=64)
+def _resident_bincount_fn(
+    num_segments: int, n_chunks: int, row: int, include_null: bool, mesh
+):
+    def kernel(*args):  # codes_0, rv_0, codes_1, rv_1, ...
+        counts = jnp.zeros(num_segments + 1, dtype=jnp.int64)
+        for i in range(n_chunks):
+            c = args[2 * i][row].astype(jnp.int64)
+            rv = args[2 * i + 1]
+            on = rv if include_null else rv & (c >= 0)
+            slot = jnp.where(on, c + 1, num_segments)
+            counts = counts + jax.ops.segment_sum(
+                jnp.ones_like(slot, dtype=jnp.int64), slot,
+                num_segments=num_segments + 1,
+            )
+        if mesh is not None:
+            counts = jax.lax.psum(counts, ROW_AXIS)
+        return counts[:num_segments]
+
+    if mesh is not None:
+        in_specs = (P(None, ROW_AXIS), P(ROW_AXIS)) * n_chunks
+        return jax.jit(
+            jax.shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=P())
+        )
+    return jax.jit(kernel)
+
+
+def _resident_string_bincount(table, column: str, include_null: bool, mesh):
+    """Counts per code slot (slot 0 = null when include_null) straight from
+    the persisted chunks, or None when the table/column is not resident.
+    Returns a DEVICE array of length cardinality+1."""
+    cache = getattr(table, "_device_cache", None)
+    if cache is None or not cache.device_chunks:
+        return None
+    if not cache.matches(mesh, [column]):
+        return None
+    packer = cache.packer
+    if column not in packer.string_names:
+        return None
+    row = packer.string_names.index(column)
+    card = len(packer.col_dict[column])
+    fn = _resident_bincount_fn(
+        card + 1, len(cache.device_chunks), row, include_null, mesh
+    )
+    args = []
+    for chunk in cache.device_chunks:
+        args.append(chunk[4])  # codes buffer
+        args.append(chunk[5])  # row_valid
+    return fn(*args)
+
+
+@lru_cache(maxsize=64)
+def _topk_from_counts_fn(kk: int, merge_null_into: int = -1):
+    """Top-k + group count from a dense counts vector. When
+    ``merge_null_into`` >= 0, slot 0 (the null group) folds into that slot
+    BEFORE ranking: the Histogram metric stringifies groups (null ->
+    "NullValue"), so a literal "NullValue" string and actual nulls are ONE
+    bin — merging after truncation would undercount whenever one of the
+    pair straddles the k boundary."""
+
+    def kernel(counts):
+        if merge_null_into >= 0:
+            counts = counts.at[merge_null_into].add(counts[0])
+            counts = counts.at[0].set(0)
+        num_groups = (counts > 0).sum()
+        top_counts, top_idx = jax.lax.top_k(counts, kk)
+        return num_groups, top_counts, top_idx
+
+    return jax.jit(kernel)
+
+
+@jax.jit
+def _stats_from_counts(counts):
+    total = counts.sum()
+    groups = (counts > 0).sum()
+    singles = (counts == 1).sum()
+    p = counts / jnp.maximum(total, 1)
+    ent = -jnp.where(counts > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0).sum()
+    return total, groups, singles, ent
+
+
 def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
     """Count key occurrences on device; psum across the mesh if present.
 
@@ -142,22 +284,7 @@ def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
     if padded != n:
         keys = np.concatenate([keys, np.full(padded - n, -1, dtype=np.int64)])
 
-    def count(k):
-        slot = jnp.where(k < 0, num_segments, k)
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(slot, dtype=jnp.int64), slot, num_segments=num_segments + 1
-        )
-        if mesh is not None:
-            counts = jax.lax.psum(counts, ROW_AXIS)
-        return counts
-
-    if mesh is not None:
-        fn = jax.jit(
-            jax.shard_map(count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
-        )
-    else:
-        fn = jax.jit(count)
-    counts = np.asarray(fn(keys))
+    counts = np.asarray(_bincount_fn(num_segments, mesh)(keys))
     return counts[:num_segments]
 
 
@@ -247,6 +374,92 @@ def group_counts(
 
 
 @dataclass(frozen=True)
+class TopKCounts:
+    """Device-computed histogram summary: total rows, distinct-group count,
+    and only the top-k (group value, count) pairs decoded to host — the
+    analogue of the reference computing top-maxDetailBins in the engine
+    (Histogram.scala:97-103) instead of collecting every group."""
+
+    num_rows: int
+    num_groups: int
+    top: Tuple[Tuple[object, int], ...]  # (value-or-None, count), count desc
+
+
+def group_top_k(
+    table: ColumnarTable,
+    column: str,
+    k: int,
+    mesh=None,
+) -> TopKCounts:
+    """Top-k most frequent values of ONE column, counts computed and ranked
+    on device; only k codes+counts are fetched and only those k distinct
+    values are decoded. Nulls form their own group (value None). Ties at
+    the k-boundary break by first-seen code order (the reference's top() is
+    similarly tie-unstable)."""
+    if mesh is None:
+        mesh = current_mesh()
+    SCAN_STATS.grouping_passes += 1
+    SCAN_STATS.rows_scanned += table.num_rows
+
+    col = table[column]
+    nv_code = -1
+    if col.dtype == DType.STRING:
+        # the Histogram metric stringifies nulls to "NullValue": if that
+        # literal also appears in the data, the two slots are ONE bin and
+        # must merge on device BEFORE top-k truncation
+        hits = np.nonzero(col.dictionary == "NullValue")[0]
+        if len(hits):
+            nv_code = int(hits[0]) + 1
+        # persisted table: counts + top-k entirely from HBM-resident codes
+        resident = _resident_string_bincount(table, column, True, mesh)
+        if resident is not None:
+            kk = min(k, len(col.dictionary) + 1)
+            num_groups, top_counts, top_idx = (
+                np.asarray(x)
+                for x in _topk_from_counts_fn(kk, nv_code)(resident)
+            )
+            top = []
+            for idx, cnt in zip(top_idx.tolist(), top_counts.tolist()):
+                if cnt <= 0:
+                    continue
+                top.append(
+                    (None if idx == 0 else col.dictionary[idx - 1], int(cnt))
+                )
+            return TopKCounts(table.num_rows, int(num_groups), tuple(top))
+        codes = col.codes.astype(np.int64) + 1
+        decode = lambda idx: col.dictionary[idx - 1]  # noqa: E731
+        card = len(col.dictionary)
+    elif col.dtype == DType.BOOLEAN:
+        codes, values = column_key_codes(col)
+        decode = lambda idx: values[idx - 1]  # noqa: E731
+        card = len(values)
+    else:
+        uniques, codes = _device_unique_inverse(col.values, col.mask)
+        cast = int if col.dtype == DType.INTEGRAL else float
+        decode = lambda idx: cast(uniques[idx - 1])  # noqa: E731
+        card = len(uniques)
+
+    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    n = len(codes)
+    padded = max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
+    if padded != n:
+        codes = np.concatenate([codes, np.full(padded - n, -1, dtype=np.int64)])
+
+    num_segments = card + 1  # slot 0 = null group
+    kk = min(k, num_segments)
+    num_groups, top_counts, top_idx = (
+        np.asarray(x) for x in _topk_fn(num_segments, kk, mesh, nv_code)(codes)
+    )
+
+    top = []
+    for idx, cnt in zip(top_idx.tolist(), top_counts.tolist()):
+        if cnt <= 0:
+            continue
+        top.append((None if idx == 0 else decode(idx), int(cnt)))
+    return TopKCounts(table.num_rows, int(num_groups), tuple(top))
+
+
+@dataclass(frozen=True)
 class CountStats:
     """Scalar aggregates of the group-count distribution — everything the
     count-only grouping analyzers (Uniqueness, UniqueValueRatio,
@@ -272,6 +485,24 @@ def group_count_stats(
         mesh = current_mesh()
     SCAN_STATS.grouping_passes += 1
     SCAN_STATS.rows_scanned += table.num_rows
+
+    # single resident string column: all four aggregates from HBM-resident
+    # codes — only 4 scalars leave the device
+    if len(columns) == 1 and table[columns[0]].dtype == DType.STRING:
+        resident = _resident_string_bincount(
+            table, columns[0], not require_any_non_null, mesh
+        )
+        if resident is not None:
+            total, groups, singles, ent = (
+                np.asarray(x) for x in _stats_from_counts(resident)
+            )
+            total = int(total)
+            return CountStats(
+                total,
+                int(groups),
+                int(singles),
+                float(ent) if total > 0 and int(groups) > 0 else float("nan"),
+            )
 
     code_arrays = []
     radices = []

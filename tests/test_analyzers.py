@@ -322,3 +322,58 @@ def test_kll_weight_conservation():
     sketch.update_batch(np.arange(n, dtype=float))
     assert sketch.rank(float(n)) == n  # total weight preserved exactly
     assert abs(sketch.quantile(0.5) - n / 2) < n * 0.15
+
+
+def test_histogram_device_topk_matches_state_path():
+    """The device top-N fast path (no states requested) must produce the
+    same Distribution as the full frequency-state path."""
+    import numpy as np
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(3)
+    n = 60_000
+    # zipf-ish skew + nulls + a numeric column
+    vals = [f"v{int(x)}" for x in rng.zipf(1.3, n) % 5_000]
+    for i in range(0, n, 97):
+        vals[i] = None
+    nums = rng.integers(0, 2_000, n).astype(np.float64)
+    t = ColumnarTable.from_pydict({"s": vals})
+    t2 = ColumnarTable([Column("x", DType.FRACTIONAL, values=nums)])
+
+    for table, col in ((t, "s"), (t2, "x")):
+        h = Histogram(col, max_detail_bins=50)
+        fast = h.calculate(table).value.get()
+        slow_metric = h.calculate(
+            table, save_states_with=InMemoryStateProvider()
+        )
+        slow = slow_metric.value.get()
+        assert fast.number_of_bins == slow.number_of_bins, col
+        # same top counts (tie ORDER at the boundary may differ; the
+        # multiset of counts and every above-boundary bin must agree)
+        assert sorted(
+            (v.absolute for v in fast.values.values()), reverse=True
+        ) == sorted((v.absolute for v in slow.values.values()), reverse=True)
+        boundary = min(v.absolute for v in fast.values.values())
+        for key, dv in slow.values.items():
+            if dv.absolute > boundary:
+                assert fast.values[key] == dv, (col, key)
+
+
+def test_histogram_nullvalue_literal_merges_with_nulls():
+    """A literal 'NullValue' string and actual nulls are ONE histogram bin
+    in both the device fast path and the state path, even when the pair
+    straddles the top-k boundary (r3 review finding)."""
+    from deequ_tpu.data.table import ColumnarTable
+    from deequ_tpu.states import InMemoryStateProvider
+
+    t = ColumnarTable.from_pydict(
+        {"s": ["NullValue", "NullValue", None, "b", "b", "c"]}
+    )
+    h = Histogram("s", max_detail_bins=2)
+    fast = h.calculate(t).value.get()
+    slow = h.calculate(t, save_states_with=InMemoryStateProvider()).value.get()
+    assert fast.number_of_bins == slow.number_of_bins == 3
+    assert fast.values["NullValue"].absolute == 3
+    assert slow.values["NullValue"].absolute == 3
+    assert fast.values == slow.values

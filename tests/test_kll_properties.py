@@ -173,9 +173,12 @@ def test_approx_quantile_where_fuses_mask():
     assert abs(ref - true) < 1.0, (ref, true)
 
 
-def test_persisted_table_gets_exact_device_quantiles():
-    """ApproxQuantile(s) on a persisted table run an exact device sort;
-    unpersisted or stateful runs keep the mergeable sketch path."""
+def test_quantiles_uniform_across_residency():
+    """ApproxQuantile(s) run the SAME device sketch path for every table
+    residency (in-memory, persisted, stateful) — identical data yields the
+    identical metric, and the approximation stays within the sketch's rank
+    error (the round-2 exact-sort fast path was removed: it returned a
+    different value for the same data depending on persistence state)."""
     from deequ_tpu.analyzers import ApproxQuantile, ApproxQuantiles
     from deequ_tpu.analyzers.runner import AnalysisRunner
     from deequ_tpu.data.table import Column, ColumnarTable, DType
@@ -186,27 +189,32 @@ def test_persisted_table_gets_exact_device_quantiles():
     vals = rng.uniform(0, 1000, n)
     mask = np.ones(n, dtype=bool)
     mask[rng.integers(0, n, 500)] = False
-    table = ColumnarTable([
+    plain = ColumnarTable([
+        Column("v", DType.FRACTIONAL, values=vals, mask=mask),
+    ])
+    persisted = ColumnarTable([
         Column("v", DType.FRACTIONAL, values=vals, mask=mask),
     ]).persist()
 
     a1 = ApproxQuantile("v", 0.5)
     a2 = ApproxQuantiles("v", (0.25, 0.5, 0.75))
-    ctx = AnalysisRunner.do_analysis_run(table, [a1, a2])
+    ctx_p = AnalysisRunner.do_analysis_run(persisted, [a1, a2])
+    ctx_m = AnalysisRunner.do_analysis_run(plain, [a1, a2])
     valid = vals[mask]
-    exact = float(np.sort(valid)[round(0.5 * (len(valid) - 1))])
-    assert ctx.metric_map[a1].value.get() == exact  # exact, not approximate
-    keyed = ctx.metric_map[a2].value.get()
-    for q in (0.25, 0.5, 0.75):
-        expect = float(np.sort(valid)[round(q * (len(valid) - 1))])
-        assert keyed[str(q)] == expect
+    # accuracy: within ~1% rank error of the exact quantile
+    for ctx in (ctx_p, ctx_m):
+        est = ctx.metric_map[a1].value.get()
+        assert abs(est - np.quantile(valid, 0.5)) < 15.0
+        keyed = ctx.metric_map[a2].value.get()
+        for q in (0.25, 0.5, 0.75):
+            assert abs(keyed[str(q)] - np.quantile(valid, q)) < 15.0
 
-    # stateful run must produce a mergeable sketch state instead
+    # stateful run produces a mergeable sketch state
     sp = InMemoryStateProvider()
-    ctx2 = AnalysisRunner.do_analysis_run(table, [a1], save_states_with=sp)
+    ctx2 = AnalysisRunner.do_analysis_run(persisted, [a1], save_states_with=sp)
     assert sp.load(a1) is not None  # KLL state persisted
-    assert abs(ctx2.metric_map[a1].value.get() - exact) < 20.0
-    table.unpersist()
+    assert abs(ctx2.metric_map[a1].value.get() - np.quantile(valid, 0.5)) < 20.0
+    persisted.unpersist()
 
 
 def test_rng_position_round_trips_through_serde():

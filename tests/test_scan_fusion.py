@@ -234,9 +234,10 @@ def test_numeric_grouping_collapses_nan_to_one_group():
 
 
 def test_streaming_batches_reuse_global_program():
-    """Incremental monitoring: the same numeric suite over successive
-    same-schema batches traces ONCE (global program cache). String columns
-    disable the cache (their dictionary LUTs are trace constants)."""
+    """Incremental monitoring: the same suite over successive same-schema
+    batches traces ONCE (global program cache). String ops qualify too —
+    their dictionary LUTs enter the program as ARGUMENTS (ops/lut_cache),
+    so per-batch dictionaries do not bake into the trace."""
     import numpy as np
 
     from deequ_tpu.data.table import Column, ColumnarTable, DType
@@ -263,15 +264,28 @@ def test_streaming_batches_reuse_global_program():
                 for s in range(4)]
     assert np.allclose(results, expected)
 
-    # string column -> per-table dictionaries -> no global reuse
+    # string columns reuse too (LUTs are inputs, not trace constants) —
+    # and each batch must still see ITS OWN dictionary, not a cached one
+    from deequ_tpu.analyzers import PatternMatch
+
     SCAN_STATS.reset()
-    for seed in range(2):
+    matches = []
+    for seed in range(3):
         rng = np.random.default_rng(seed)
-        t = ColumnarTable.from_pydict(
-            {"s": [f"v{i}" for i in rng.integers(0, 5, 64)]}
+        strings = [
+            ("ok" if x else f"bad{seed}") for x in rng.integers(0, 2, 64)
+        ]
+        t = ColumnarTable.from_pydict({"s": strings})
+        ctx = AnalysisRunner.do_analysis_run(
+            t, [Completeness("s"), PatternMatch("s", "^ok$")]
         )
-        AnalysisRunner.do_analysis_run(t, [Completeness("s")])
-    assert SCAN_STATS.programs_built == 2
+        expect = sum(1 for s in strings if s == "ok") / len(strings)
+        got = ctx.metric_map[PatternMatch("s", "^ok$")].value.get()
+        assert got == expect, (seed, got, expect)
+        matches.append(got)
+    assert SCAN_STATS.programs_built == 1
+    assert SCAN_STATS.programs_reused == 2
+    assert len(set(matches)) > 1  # genuinely different per-batch answers
 
 
 def test_count_stats_fast_path_matches_full_path():
