@@ -15,6 +15,7 @@ scan). vs_baseline = measured_rows_per_sec / 1.0e6.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -22,18 +23,19 @@ import numpy as np
 N_ROWS = 10_000_000
 N_COLS = 20
 SPARK_LOCAL32_ROWS_PER_SEC = 1.0e6
+SMOKE_ROWS = 100_000
 
 
-def build_table():
+def build_table(n_rows: int = N_ROWS):
     from deequ_tpu.data.table import Column, ColumnarTable, DType
 
     rng = np.random.default_rng(7)
     cols = []
     for i in range(N_COLS):
-        values = rng.normal(100.0 + i, 5.0, N_ROWS)
-        mask = np.ones(N_ROWS, dtype=np.bool_)
+        values = rng.normal(100.0 + i, 5.0, n_rows)
+        mask = np.ones(n_rows, dtype=np.bool_)
         # sprinkle nulls so Completeness has work to do
-        mask[rng.integers(0, N_ROWS, N_ROWS // 100)] = False
+        mask[rng.integers(0, n_rows, n_rows // 100)] = False
         cols.append(Column(f"c{i}", DType.FRACTIONAL, values=values, mask=mask))
     return ColumnarTable(cols)
 
@@ -64,7 +66,12 @@ def main():
     from deequ_tpu.analyzers.runner import AnalysisRunner
     from deequ_tpu.ops.scan_engine import SCAN_STATS
 
-    table = build_table()
+    # --smoke: pre-commit gate (<10s): same program shape at 100k rows,
+    # asserts the fused scan still runs green end-to-end (the round-1
+    # regression shipped because no cheap bench check existed)
+    smoke = "--smoke" in sys.argv
+    n_rows = SMOKE_ROWS if smoke else N_ROWS
+    table = build_table(n_rows)
     analyzers = build_analyzers()
 
     # The Spark local[32] estimate (~1M rows/s) is for a fused aggregation
@@ -91,7 +98,29 @@ def main():
     assert SCAN_STATS.resident_passes == 1, "resident-path regression"
     assert SCAN_STATS.bytes_packed == 0, "unexpected host re-transfer"
 
-    rows_per_sec = N_ROWS / wall
+    rows_per_sec = n_rows / wall
+    # execution breakdown to stderr (the driver parses stdout's single line)
+    snap = SCAN_STATS.snapshot()
+    print(
+        f"breakdown: wall={wall:.3f}s dispatch={snap['dispatch_seconds']:.3f}s "
+        f"drain_wait={snap['drain_wait_seconds']:.3f}s "
+        f"bytes_resident={snap['bytes_resident']/1e9:.2f}GB "
+        f"effective={SCAN_STATS.effective_bytes_per_sec()/1e9:.1f}GB/s "
+        f"(v5e HBM peak ~819GB/s)",
+        file=sys.stderr,
+    )
+    if smoke:
+        print(
+            json.dumps(
+                {
+                    "metric": "smoke_profile_scan_100kx20_ok",
+                    "value": round(rows_per_sec, 1),
+                    "unit": "rows/sec",
+                    "vs_baseline": 1.0,
+                }
+            )
+        )
+        return
     print(
         json.dumps(
             {

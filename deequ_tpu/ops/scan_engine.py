@@ -126,9 +126,22 @@ class ScanStats:
         self.programs_built = 0
         self.programs_reused = 0
         self.device_sort_passes = 0
+        # time spent issuing step dispatches (host-side enqueue; near zero
+        # unless the runtime backpressures) vs time blocked waiting for
+        # device results in drain. drain_wait ~= device compute + any
+        # in-flight transfer not hidden by the pipeline window; the gap
+        # between scan_seconds and (dispatch + drain_wait) is host packing.
+        self.dispatch_seconds = 0.0
+        self.drain_wait_seconds = 0.0
 
     def snapshot(self) -> dict:
         return dict(self.__dict__)
+
+    def effective_bytes_per_sec(self) -> float:
+        """Scanned bytes per wall second across all passes (compare to the
+        chip's HBM bandwidth for a utilization denominator)."""
+        total = self.bytes_packed + self.bytes_resident
+        return total / self.scan_seconds if self.scan_seconds > 0 else 0.0
 
 
 SCAN_STATS = ScanStats()
@@ -688,7 +701,11 @@ class _PartialFolder:
         self.shapes = None
 
     def drain(self, device_result) -> None:
+        import time as _time
+
+        t0 = _time.time()
         flat = np.asarray(device_result)
+        SCAN_STATS.drain_wait_seconds += _time.time() - t0
         partials = _unflatten_partials(flat, self.shapes)
         SCAN_STATS.chunks_processed += 1
         if self.merged is None:
@@ -804,7 +821,9 @@ def run_scan(
                     cache.put_program(prog_key, (step_fn, folder.shapes))
                 if global_key is not None:
                     _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
+            t_d = _time.time()
             in_flight.append(step_fn(*args, lut_arrays))
+            SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
     else:
@@ -817,7 +836,9 @@ def run_scan(
                 folder.shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
                 if global_key is not None:
                     _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
+            t_d = _time.time()
             in_flight.append(step_fn(*put(args), lut_arrays))
+            SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
     for device_result in in_flight:
@@ -1020,7 +1041,9 @@ def _run_scan_stream(
                         _GLOBAL_PROGRAMS.put(global_key, (step_fn, shapes))
             if folder.shapes is None:
                 folder.shapes = shapes
+            t_d = _time.time()
             in_flight.append(step_fn(*put(args), lut_arrays))
+            SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
             if stop >= n:

@@ -114,3 +114,29 @@ def test_ertl_estimator_accuracy_across_range():
         assert mean_abs <= k / np.sqrt(m), (n, mean_abs, k)
         # no systematic bias: signed mean well inside the error bound
         assert abs(signed) <= bound, (n, signed, bound)
+
+
+def test_mxu_fold_matches_segment_max():
+    """The one-hot-matmul register fold (TPU path) must equal the
+    scatter-max fold bit-for-bit; tested by calling the fold directly (the
+    platform gate would otherwise keep it unreachable on the CPU suite)."""
+    import jax.numpy as jnp
+
+    from deequ_tpu.ops.hll import _MXU_FOLD_MIN_ROWS, _registers_mxu_fold
+
+    rng = np.random.default_rng(5)
+    n = _MXU_FOLD_MIN_ROWS + 12_345
+    m = 512
+    idx = jnp.asarray(rng.integers(0, m, n).astype(np.int32))
+    # include rank 0 (invalid rows), sparse high ranks, and empty buckets
+    rank = rng.integers(0, 4, n).astype(np.int32) * rng.integers(0, 2, n)
+    rank[:50] = rng.integers(25, 57, 50)
+    idx = idx.at[:100].set(0)
+    rank = jnp.asarray(rank)
+
+    import jax
+
+    expected = np.zeros(m, np.int64)
+    np.maximum.at(expected, np.asarray(idx), np.asarray(rank))
+    got = np.asarray(_registers_mxu_fold(idx, rank, m, jnp))
+    assert np.array_equal(got, expected.astype(np.int32))
