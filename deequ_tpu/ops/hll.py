@@ -305,4 +305,7 @@ def estimate_cardinality(registers: np.ndarray) -> float:
     for k in range(q, 0, -1):
         z = 0.5 * (z + counts[k])
     z = z + m * _sigma(counts[0] / m)
-    return float(alpha_inf * m * m / z)
+    # cardinality is a whole number: round like the reference
+    # (StatefulHyperloglogPlus.scala count() ends with Java Math.round,
+    # which is floor(x + 0.5) — python round() would go half-to-even)
+    return float(math.floor(alpha_inf * m * m / z + 0.5))

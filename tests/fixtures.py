@@ -1,0 +1,98 @@
+"""Reference-pinned test fixtures — our implementation of the reference's
+fixture matrix (src/test/scala/com/amazon/deequ/utils/FixtureSupport.scala:
+26-259), with the exact row data the reference's AnalyzerTests.scala pins
+golden values on.
+
+Named ``ref_df_*`` deliberately: tests/conftest.py defines pytest fixtures
+with similar ``df_*`` names but DIFFERENT data (they predate this module);
+the prefix keeps the two matrices from shadowing each other when a test
+takes a fixture by argument name."""
+
+from deequ_tpu.data.table import ColumnarTable
+
+
+def ref_df_missing() -> ColumnarTable:
+    """12 rows; att1 6/12 non-null, att2 9/12 non-null
+    (FixtureSupport.getDfMissing)."""
+    return ColumnarTable.from_pydict({
+        "item": [str(i) for i in range(1, 13)],
+        "att1": ["a", "b", None, "a", "a", None, None, "b", "a", None, None, None],
+        "att2": ["f", "d", "f", None, "f", "d", "d", None, "f", None, "f", "d"],
+    })
+
+
+def ref_df_full() -> ColumnarTable:
+    """(FixtureSupport.getDfFull)"""
+    return ColumnarTable.from_pydict({
+        "item": ["1", "2", "3", "4"],
+        "att1": ["a", "a", "a", "b"],
+        "att2": ["c", "c", "c", "d"],
+    })
+
+
+def ref_df_with_numeric_values() -> ColumnarTable:
+    """att1 = 1..6; att2/att3 are 0 on rows 1-3 and larger on rows 4-6,
+    with att3 <= att2 everywhere (FixtureSupport.getDfWithNumericValues)."""
+    return ColumnarTable.from_pydict({
+        "item": ["1", "2", "3", "4", "5", "6"],
+        "att1": [1, 2, 3, 4, 5, 6],
+        "att2": [0, 0, 0, 5, 6, 7],
+        "att3": [0, 0, 0, 4, 6, 7],
+    })
+
+
+def ref_df_with_numeric_fractional_values() -> ColumnarTable:
+    return ColumnarTable.from_pydict({
+        "item": ["1", "2", "3", "4", "5", "6"],
+        "att1": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+        "att2": [0.0, 0.0, 0.0, 5.0, 6.0, 7.0],
+    })
+
+
+def ref_df_with_unique_columns() -> ColumnarTable:
+    """(FixtureSupport.getDfWithUniqueColumns)"""
+    return ColumnarTable.from_pydict({
+        "unique": ["1", "2", "3", "4", "5", "6"],
+        "nonUnique": ["0", "0", "0", "5", "6", "7"],
+        "nonUniqueWithNulls": ["3", "3", "3", None, None, None],
+        "uniqueWithNulls": ["1", "2", None, "3", "4", "5"],
+        "onlyUniqueWithOtherNonUnique": ["5", "6", "7", "0", "0", "0"],
+        "halfUniqueCombinedWithNonUnique": ["0", "0", "0", "4", "5", "6"],
+    })
+
+
+def ref_df_with_distinct_values() -> ColumnarTable:
+    """(FixtureSupport.getDfWithDistinctValues)"""
+    return ColumnarTable.from_pydict({
+        "att1": ["a", "a", None, "b", "b", "c"],
+        "att2": [None, None, "x", "x", "x", "y"],
+    })
+
+
+def ref_df_uninformative() -> ColumnarTable:
+    """att2 constant (getDfWithConditionallyUninformativeColumns)."""
+    return ColumnarTable.from_pydict({"att1": [1, 2, 3], "att2": [0, 0, 0]})
+
+
+def ref_df_informative() -> ColumnarTable:
+    """att2 = att1 + 3 (getDfWithConditionallyInformativeColumns)."""
+    return ColumnarTable.from_pydict({"att1": [1, 2, 3], "att2": [4, 5, 6]})
+
+
+def ref_df_variable_string_lengths() -> ColumnarTable:
+    """'', 'a', 'bb', 'ccc', 'dddd' (getDfWithVariableStringLengthValues)."""
+    return ColumnarTable.from_pydict({"att1": ["", "a", "bb", "ccc", "dddd"]})
+
+
+def ref_df_complete_incomplete() -> ColumnarTable:
+    """(getDfCompleteAndInCompleteColumns)"""
+    return ColumnarTable.from_pydict({
+        "item": ["1", "2", "3", "4", "5", "6"],
+        "att1": ["a", "b", "a", "a", "b", "a"],
+        "att2": ["f", "d", None, "f", None, "f"],
+    })
+
+
+def ref_df_empty_strings() -> ColumnarTable:
+    """Zero-row table with two string columns (getDfEmpty)."""
+    return ColumnarTable.from_pydict({"column1": [], "column2": []})

@@ -190,3 +190,74 @@ def test_fail_if_results_missing(df_with_numeric_values, repository):
             reuse_existing_results_for_key=ResultKey(1, {}),
             fail_if_results_missing=True,
         )
+
+
+def test_every_analyzer_metric_round_trips_with_exact_values():
+    """The full analyzer x metric serde matrix (AnalysisResultSerdeTest
+    analogue): run EVERY analyzer type over one mixed fixture, serialize
+    the result set to JSON, deserialize, and require exact value equality
+    for every successful metric (scalars, keyed, histograms, KLL buckets)
+    and failure preservation for failed ones."""
+    table = ColumnarTable.from_pydict({
+        "col": [1.0, 2.0, 3.0, 4.0, 5.0, None],
+        "a": ["x", "y", "x", None, "z", "x"],
+        "b": ["1", "2", "3", "4", "5", "6"],
+        "s": ["ab", "cde", "", "ab", None, "f"],
+        "x": [1, 2, 3, 4, 5, 6],
+    })
+    analyzers = [
+        Size(),
+        Size(where="x > 2"),
+        Completeness("col"),
+        Compliance("rule", "x > 3"),
+        PatternMatch("s", r"^[a-z]+$"),
+        Minimum("col"), Maximum("col"),
+        MinLength("s"), MaxLength("s"),
+        Mean("col"), Sum("col"), StandardDeviation("col"),
+        Correlation("col", "x"),
+        DataType("b"),
+        ApproxCountDistinct("a"),
+        ApproxQuantile("col", 0.5),
+        ApproxQuantiles("col", [0.25, 0.5, 0.75]),
+        KLLSketch("col"),
+        Uniqueness(("a",)), UniqueValueRatio(("a",)),
+        Distinctness(("a",)), CountDistinct(("a", "b")),
+        Entropy("a"),
+        MutualInformation(("a", "b")),
+        Histogram("a"),
+        # failure cases must survive serde as failures
+        Mean("a"),            # non-numeric -> precondition failure
+        Completeness("nope"),  # missing column
+    ]
+    ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+    assert set(ctx.metric_map) == set(analyzers)
+
+    text = serde.serialize(
+        [AnalysisResult(ResultKey(777, {"env": "test"}), ctx)]
+    )
+    [back] = serde.deserialize(text)
+    restored = back.analyzer_context.metric_map
+    assert set(restored) == set(analyzers)
+
+    for analyzer, metric in ctx.metric_map.items():
+        r = restored[analyzer]
+        assert type(r) is type(metric), analyzer
+        assert r.entity == metric.entity
+        assert r.name == metric.name
+        assert r.instance == metric.instance
+        if metric.value.is_failure:
+            assert r.value.is_failure, analyzer
+            continue
+        v, rv = metric.value.get(), r.value.get()
+        if isinstance(v, float):
+            assert rv == v or (math.isnan(v) and math.isnan(rv)), analyzer
+        elif isinstance(v, dict):
+            assert rv == v, analyzer
+        elif hasattr(v, "values"):  # Distribution
+            assert rv.values == v.values and rv.number_of_bins == v.number_of_bins
+        elif hasattr(v, "buckets"):  # BucketDistribution
+            assert rv.buckets == v.buckets, analyzer
+            assert rv.parameters == v.parameters
+            assert rv.data == v.data
+        else:
+            assert rv == v, analyzer
