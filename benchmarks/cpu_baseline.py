@@ -1,0 +1,92 @@
+"""Measured single-host CPU baseline for the headline workload (config 2).
+
+Round-2 review noted the Spark local[32] denominator (~1M rows/s) was a
+documented ESTIMATE with no in-repo measurement. This script anchors it:
+the same 105-metric workload (Size + per-column Completeness/Mean/StdDev/
+Min/Max over 10M x 20 f64 + HLL distinct on 4 columns) implemented
+directly in vectorized numpy — the fastest plausible single-threaded CPU
+engine (no Python-per-row overhead, data already in RAM, single pass of
+vectorized reductions per column).
+
+Prints one JSON line {metric, value, unit, host_cpus}. Interpretation:
+numpy on ONE core measures X rows/s; Spark local[32] on 32 cores with
+whole-stage codegen lands within a small factor of 32x a single numpy
+core for this embarrassingly-parallel scan — so the ~1M rows/s estimate
+can be sanity-checked as (this measurement) x cores / JVM overhead.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+N_ROWS = 10_000_000
+N_COLS = 20
+
+
+def build():
+    rng = np.random.default_rng(7)
+    cols = []
+    for i in range(N_COLS):
+        values = rng.normal(100.0 + i, 5.0, N_ROWS)
+        mask = np.ones(N_ROWS, dtype=np.bool_)
+        mask[rng.integers(0, N_ROWS, N_ROWS // 100)] = False
+        cols.append((values, mask))
+    return cols
+
+
+def hll_registers(values: np.ndarray, p: int = 9) -> np.ndarray:
+    """Same HLL algebra as the engine, in numpy (uses the engine's own
+    host-path kernels so the workload is identical)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from deequ_tpu.ops import hll as hll_ops
+
+    hashes = hll_ops.hash_numeric_device(values, np)
+    valid = np.ones(len(values), dtype=bool)
+    return hll_ops.registers_from_hashes(hashes, valid, p, np)
+
+
+def run_once(cols) -> dict:
+    out = {}
+    for i, (values, mask) in enumerate(cols):
+        n_valid = int(mask.sum())
+        out[f"c{i}.completeness"] = n_valid / N_ROWS
+        masked = np.where(mask, values, 0.0)
+        s = masked.sum()
+        out[f"c{i}.mean"] = s / n_valid
+        d = np.where(mask, values - s / n_valid, 0.0)
+        out[f"c{i}.std"] = float(np.sqrt((d * d).sum() / n_valid))
+        out[f"c{i}.min"] = float(np.where(mask, values, np.inf).min())
+        out[f"c{i}.max"] = float(np.where(mask, values, -np.inf).max())
+    for i in range(4):
+        values, mask = cols[i]
+        out[f"c{i}.hll"] = hll_registers(values[mask])
+    out["size"] = N_ROWS
+    return out
+
+
+def main():
+    cols = build()
+    run_once(cols)  # warm numpy caches
+    t0 = time.time()
+    run_once(cols)
+    wall = time.time() - t0
+    rows_per_sec = N_ROWS / wall
+    print(
+        json.dumps(
+            {
+                "metric": "cpu_numpy_profile_scan_10Mx20_rows_per_sec",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec",
+                "wall_seconds": round(wall, 3),
+                "host_cpus": os.cpu_count(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

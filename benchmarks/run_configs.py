@@ -74,27 +74,19 @@ def config3(n_rows: int):
     analyzers = [Correlation(f"c{2*i}", f"c{2*i+1}") for i in range(n_cols // 2)]
     analyzers += [ApproxQuantile(f"c{i}", 0.5) for i in range(n_cols)]
 
-    # warmup at the SAME shapes with different content: compiles are cached,
-    # while the timed run's transfers stay novel (the tunnel content-dedups
-    # identical buffers, which would flatter a same-data warmup)
-    warm = ColumnarTable(
-        [
-            Column(f"c{i}", DType.FRACTIONAL, values=rng.normal(0, 1, n_rows))
-            for i in range(n_cols)
-        ]
-    )
-    try:
-        warm.persist()
-    except MemoryError:
-        pass
-    AnalysisRunner.do_analysis_run(warm, analyzers)
-    warm.unpersist()
-    del warm
-
+    # the timed quantity is the steady-state RESIDENT scan (persist is the
+    # untimed df.cache() analogue): once resident, a same-table warmup is
+    # fair because no bytes move during timed runs. If persist fails
+    # (table exceeds the HBM budget), warming on the same content would
+    # let the tunnel's content-dedup flatter the timed re-transfer — so
+    # the non-resident path runs COLD (compile + transfer included) and
+    # the emitted record says so.
     try:
         table.persist()
     except MemoryError:
         pass
+    if table.is_persisted:
+        AnalysisRunner.do_analysis_run(table, analyzers)
     t0 = time.time()
     ctx = AnalysisRunner.do_analysis_run(table, analyzers)
     wall = time.time() - t0
@@ -103,7 +95,7 @@ def config3(n_rows: int):
     return _emit(
         config=3, metric="corr_kll_50col_rows_per_sec", rows=n_rows,
         value=round(n_rows / wall, 1), unit="rows/sec",
-        wall_seconds=round(wall, 3),
+        wall_seconds=round(wall, 3), resident=table.is_persisted,
     )
 
 
@@ -124,23 +116,14 @@ def config4(n_rows: int):
     analyzers = [
         ApproxCountDistinct("key"), Histogram("key"), Uniqueness(("key",)),
     ]
-    # same-shape different-content warmup (see config3 comment)
-    warm_codes = rng.integers(0, cardinality, n_rows).astype(np.int32)
-    warm = ColumnarTable(
-        [Column("key", DType.STRING, codes=warm_codes, dictionary=dictionary)]
-    )
-    try:
-        warm.persist()
-    except MemoryError:
-        pass
-    AnalysisRunner.do_analysis_run(warm, analyzers)
-    warm.unpersist()
-    del warm
-
+    # timed runs are HBM-resident when possible; cold otherwise (see
+    # config3 comment on the content-dedup hazard)
     try:
         table.persist()
     except MemoryError:
         pass
+    if table.is_persisted:
+        AnalysisRunner.do_analysis_run(table, analyzers)
     t0 = time.time()
     ctx = AnalysisRunner.do_analysis_run(table, analyzers)
     wall = time.time() - t0
@@ -152,7 +135,64 @@ def config4(n_rows: int):
     return _emit(
         config=4, metric="hll_histogram_highcard_rows_per_sec", rows=n_rows,
         value=round(n_rows / wall, 1), unit="rows/sec",
-        wall_seconds=round(wall, 3),
+        wall_seconds=round(wall, 3), resident=table.is_persisted,
+    )
+
+
+def config5_from_disk(n_batches: int, batch_rows: int, tmpdir: str = "/tmp"):
+    """Config #5 with batches arriving FROM DISK (Parquet): the incremental
+    monitoring loop reads each day's delta out-of-core via stream_parquet,
+    merges into running states, and never materializes more than a batch —
+    the spec-scale (1B rows / 100 batches) shape, scaled to this host."""
+    import os
+    import shutil
+
+    from deequ_tpu.analyzers import Mean, Size, StandardDeviation
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.io import stream_parquet, write_parquet
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.repository import AnalysisResult, ResultKey
+    from deequ_tpu.repository.memory import InMemoryMetricsRepository
+    from deequ_tpu.states import InMemoryStateProvider
+
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="deequ_cfg5_", dir=tmpdir)
+    try:
+        rng = np.random.default_rng(44)
+        paths = []
+        for b in range(n_batches):
+            path = os.path.join(workdir, f"batch_{b:04d}.parquet")
+            write_parquet(
+                ColumnarTable(
+                    [Column("v", DType.FRACTIONAL,
+                            values=rng.normal(100.0, 5.0, batch_rows))]
+                ),
+                path,
+            )
+            paths.append(path)
+
+        analyzers = [Size(), Mean("v"), StandardDeviation("v")]
+        repo = InMemoryMetricsRepository()
+        states = InMemoryStateProvider()
+        t0 = time.time()
+        for b, path in enumerate(paths):
+            ctx = AnalysisRunner.do_analysis_run(
+                stream_parquet(path), analyzers,
+                aggregate_with=states, save_states_with=states,
+            )
+            repo.save(AnalysisResult(ResultKey(b, {"stream": "disk"}), ctx))
+        wall = time.time() - t0
+        total = n_batches * batch_rows
+        final = repo.load_by_key(ResultKey(n_batches - 1, {"stream": "disk"}))
+        size = final.analyzer_context.metric_map[Size()].value.get()
+        assert size == total, (size, total)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return _emit(
+        config=5, metric="incremental_disk_stream_rows_per_sec", rows=total,
+        value=round(total / wall, 1), unit="rows/sec",
+        wall_seconds=round(wall, 3), batches=n_batches,
     )
 
 
@@ -218,6 +258,9 @@ def main():
         3: lambda: config3(args.rows or 4_000_000),
         4: lambda: config4(args.rows or 4_000_000),
         5: lambda: config5(50, (args.rows or 10_000_000) // 50),
+        # config 5 with batches read out-of-core from Parquet on disk
+        # (python benchmarks/run_configs.py --config 50)
+        50: lambda: config5_from_disk(20, (args.rows or 10_000_000) // 20),
     }
     if args.all:
         for k in sorted(runners):

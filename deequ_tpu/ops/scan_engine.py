@@ -969,6 +969,13 @@ def _run_scan_stream(
             dtypes.values(), target_bytes=STREAM_CHUNK_BYTES
         )
     )
+    # a small source must not pay for a full-width padded chunk: bound by
+    # the metadata row count when the source knows it
+    known_rows = getattr(stream.source, "num_rows", None) if hasattr(
+        stream, "source"
+    ) else None
+    if known_rows:
+        chunk = min(chunk, known_rows)
     chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
     local_n = chunk // n_dev if mesh is not None else chunk
     put = _make_put(mesh)
