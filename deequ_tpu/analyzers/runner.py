@@ -225,6 +225,54 @@ class AnalysisRunner:
         return result
 
     @staticmethod
+    def _coalesce_scan_ops(ops):
+        """Merge ops that share a batch_hint kind/params into one vectorized
+        op (currently: N same-parameter where-free KLL sorts -> one vmapped
+        batched sort, the dominant cost of wide quantile profiles).
+
+        Returns (exec_ops, plan) where plan[i] = (exec_index, extractor or
+        None) for scannable[i]."""
+        from deequ_tpu.analyzers.sketches import (
+            _kll_multi_extract,
+            _kll_multi_scan_op,
+        )
+        from deequ_tpu.ops.scan_engine import ScanOp
+
+        groups: Dict[Tuple, List[int]] = {}
+        for i, op in enumerate(ops):
+            hint = op.batch_hint
+            if hint is not None and hint[0] == "kll":
+                groups.setdefault(("kll", hint[1]), []).append(i)
+
+        mergeable = {
+            key: idxs for key, idxs in groups.items() if len(idxs) >= 2
+        }
+        if not mergeable:
+            return list(ops), [(i, None) for i in range(len(ops))]
+
+        exec_ops: List[ScanOp] = []
+        plan: List[Optional[Tuple[int, Optional[callable]]]] = [None] * len(ops)
+        merged_members = {i for idxs in mergeable.values() for i in idxs}
+        for i, op in enumerate(ops):
+            if i in merged_members:
+                continue
+            plan[i] = (len(exec_ops), None)
+            exec_ops.append(op)
+        for (kind, sketch_size), idxs in sorted(mergeable.items()):
+            columns = tuple(ops[i].batch_hint[2] for i in idxs)
+            merged = _kll_multi_scan_op(columns, sketch_size)
+            merged.cache_key = ("kll_batch", sketch_size, columns)
+            exec_idx = len(exec_ops)
+            exec_ops.append(merged)
+            K = len(idxs)
+            for j, i in enumerate(idxs):
+                plan[i] = (
+                    exec_idx,
+                    (lambda result, j=j, K=K: _kll_multi_extract(result, j, K)),
+                )
+        return exec_ops, plan
+
+    @staticmethod
     def _run_scanning_analyzers(
         data: ColumnarTable,
         analyzers: Sequence[ScanShareableAnalyzer],
@@ -253,15 +301,19 @@ class AnalysisRunner:
         if not scannable:
             return ctx
         try:
-            results = run_scan(data, ops)
+            exec_ops, plan = AnalysisRunner._coalesce_scan_ops(ops)
+            results = run_scan(data, exec_ops)
         except Exception as e:  # noqa: BLE001 — a failure inside the shared
             # scan maps onto every participating analyzer (reference L320-323)
             wrapped = wrap_if_necessary(e)
             for a in scannable:
                 ctx.metric_map[a] = a.to_failure_metric(wrapped)
             return ctx
-        for analyzer, result in zip(scannable, results):
+        for analyzer, (exec_idx, extract) in zip(scannable, plan):
             try:
+                result = results[exec_idx]
+                if extract is not None:
+                    result = extract(result)
                 state = analyzer.state_from_scan_result(result)
             except Exception as e:  # noqa: BLE001
                 ctx.metric_map[analyzer] = analyzer.to_failure_metric(

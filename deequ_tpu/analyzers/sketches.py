@@ -300,10 +300,51 @@ def _kll_scan_op(
         "min": "min",
         "max": "max",
     }
+    # where-free single-column KLL ops are coalescible into one batched
+    # sort (see _kll_multi_scan_op / runner._coalesce_scan_ops)
+    hint = ("kll", sketch_size, column) if where is None else None
     return ScanOp(
         tuple(sorted(cols)), update, tags,
         dictionary_baked=_string_baked(table, wcols),
+        batch_hint=hint,
     )
+
+
+def _kll_multi_scan_op(columns: Tuple[str, ...], sketch_size: int) -> ScanOp:
+    """N same-parameter KLL columns as ONE op: stack to (K, n), run one
+    vmapped batched sort + strata compaction (ops/kll_device.py). The
+    planner builds this from coalescible single-column ops; per-analyzer
+    results are sliced back out by leading-axis stride (runner)."""
+    from deequ_tpu.ops.kll_device import chunk_summary_batched
+
+    def update(vals, row_valid, xp, n):
+        X = xp.stack([vals[c].data for c in columns])
+        M = xp.stack([vals[c].mask & row_valid for c in columns])
+        return chunk_summary_batched(X, M, sketch_size, n, xp)
+
+    tags = {
+        "items": "gather",
+        "weights": "gather",
+        "count": "sum",
+        "min": "min",
+        "max": "max",
+    }
+    return ScanOp(tuple(sorted(columns)), update, tags)
+
+
+def _kll_multi_extract(result, j: int, K: int) -> dict:
+    """Slice column j's summary out of a batched KLL result. Gathered
+    leaves concatenate along the leading axis in blocks of K rows (one
+    block per chunk/device), so column j occupies rows j, j+K, j+2K, ..."""
+    items = np.asarray(result["items"])
+    weights = np.asarray(result["weights"])
+    return {
+        "items": items[j::K].ravel(),
+        "weights": weights[j::K].ravel(),
+        "count": np.asarray(result["count"])[j],
+        "min": np.asarray(result["min"])[j],
+        "max": np.asarray(result["max"])[j],
+    }
 
 
 def _kll_state_from_result(

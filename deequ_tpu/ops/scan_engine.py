@@ -101,6 +101,10 @@ class ScanOp:
     # where-predicate comparing string literals) — such programs bake
     # table-specific constants and are excluded from cross-table caches
     dictionary_baked: bool = False
+    # optional coalescing hint: ops sharing a batch_hint "kind" can be
+    # merged by the planner into ONE vectorized op (e.g. N same-parameter
+    # KLL sorts -> one vmapped batched sort). Shape: (kind, params, column).
+    batch_hint: Optional[Tuple] = None
 
 
 class ScanStats:

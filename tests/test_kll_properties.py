@@ -279,3 +279,48 @@ def test_persisted_exact_path_matches_sketch_rank_rule():
     assert ApproxQuantile("x", 0.5).calculate(persisted).value.get() == (
         sorted_v[99]
     )
+
+
+def test_kll_op_coalescing_matches_individual_results():
+    """N same-parameter ApproxQuantile ops coalesce into ONE batched-sort
+    op; per-column results must be identical to running each column in
+    its own scan, and mixed analyzer sets must keep 1 fused pass."""
+    from deequ_tpu.analyzers import ApproxQuantile, Mean, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    rng = np.random.default_rng(17)
+    n, k_cols = 40_000, 6
+    cols = [
+        Column(f"c{i}", DType.FRACTIONAL, values=rng.normal(10 * i, 3, n))
+        for i in range(k_cols)
+    ]
+    table = ColumnarTable(cols)
+    quants = [ApproxQuantile(f"c{i}", 0.5) for i in range(k_cols)]
+    analyzers = [Size(), Mean("c0")] + quants
+
+    SCAN_STATS.reset()
+    ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+    assert SCAN_STATS.scan_passes == 1  # coalescing keeps the single pass
+
+    for i, a in enumerate(quants):
+        batched = ctx.metric_map[a].value.get()
+        solo = AnalysisRunner.do_analysis_run(
+            ColumnarTable([cols[i]]), [ApproxQuantile(f"c{i}", 0.5)]
+        ).metric_map[ApproxQuantile(f"c{i}", 0.5)].value.get()
+        assert batched == solo, (i, batched, solo)
+        assert abs(batched - 10 * i) < 0.5, (i, batched)
+
+    # ops with where-predicates must NOT coalesce (different row masks):
+    # the filtered quantile must equal a solo filtered run, not the
+    # unfiltered one a wrongly-merged batch would produce
+    w = ApproxQuantile("c1", 0.5, where="c0 > 12")
+    ctx2 = AnalysisRunner.do_analysis_run(table, [w] + quants)
+    got = ctx2.metric_map[w].value.get()
+    solo = AnalysisRunner.do_analysis_run(
+        table, [ApproxQuantile("c1", 0.5, where="c0 > 12")]
+    ).metric_map[w].value.get()
+    unfiltered = ctx2.metric_map[ApproxQuantile("c1", 0.5)].value.get()
+    assert got == solo
+    assert got != unfiltered  # c0 > 12 keeps a skewed subset of rows
