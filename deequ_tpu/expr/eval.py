@@ -103,11 +103,20 @@ class EvalContext:
         return self.columns[name]
 
 
-def _str_lut_bool(ctx: EvalContext, col: Val, fn: Callable[[str], bool]) -> Val:
-    """Apply a per-distinct-value predicate as a device lookup table."""
-    lut = np.array([bool(fn(v)) for v in col.dictionary], dtype=np.bool_)
-    if len(lut) == 0:
-        lut = np.zeros(1, dtype=np.bool_)
+def _str_lut_bool(
+    ctx: EvalContext, col: Val, fn: Callable[[str], bool], kind: str
+) -> Val:
+    """Apply a per-distinct-value predicate as a device lookup table.
+    ``kind`` (a stable description of the predicate) memoizes the LUT per
+    dictionary (ops/lut_cache.py) so retraced programs skip the
+    O(cardinality) host rebuild."""
+    from deequ_tpu.ops.lut_cache import dictionary_lut
+
+    def build(dictionary):
+        lut = np.array([bool(fn(v)) for v in dictionary], dtype=np.bool_)
+        return lut if len(lut) else np.zeros(1, dtype=np.bool_)
+
+    lut = dictionary_lut(col.dictionary, f"pred:{kind}", build)
     xp = ctx.xp
     codes = col.data
     safe = xp.maximum(codes, 0)
@@ -116,19 +125,25 @@ def _str_lut_bool(ctx: EvalContext, col: Val, fn: Callable[[str], bool]) -> Val:
 
 
 def _str_col_as_num(ctx: EvalContext, col: Val) -> Val:
-    """Cast a string column to numeric via the dictionary (unparsable -> null)."""
-    lut = np.zeros(max(len(col.dictionary), 1), dtype=np.float64)
-    ok = np.zeros(max(len(col.dictionary), 1), dtype=np.bool_)
-    for i, v in enumerate(col.dictionary):
-        try:
-            lut[i] = float(v)
-            ok[i] = True
-        except (TypeError, ValueError):
-            pass
+    """Cast a string column to numeric via the dictionary (unparsable ->
+    null); the LUT pair memoizes per dictionary."""
+    from deequ_tpu.ops.lut_cache import dictionary_lut
+
+    def build(dictionary):
+        lut = np.zeros((2, max(len(dictionary), 1)), dtype=np.float64)
+        for i, v in enumerate(dictionary):
+            try:
+                lut[0, i] = float(v)
+                lut[1, i] = 1.0
+            except (TypeError, ValueError):
+                pass
+        return lut
+
+    pair = dictionary_lut(col.dictionary, "strtonum", build)
     xp = ctx.xp
     safe = xp.maximum(col.data, 0)
-    vals = xp.asarray(lut)[safe]
-    mask = (col.data >= 0) & xp.asarray(ok)[safe]
+    vals = xp.asarray(pair[0])[safe]
+    mask = (col.data >= 0) & (xp.asarray(pair[1])[safe] > 0)
     return Val("num", vals, mask)
 
 
@@ -180,7 +195,10 @@ def eval_expression(expr: Expr, ctx: EvalContext) -> Val:
         operand = eval_expression(expr.operand, ctx)
         if operand.kind == "str" and operand.dictionary is not None:
             opts = {str(o) for o in expr.options if o is not None}
-            res = _str_lut_bool(ctx, operand, lambda s: s in opts)
+            res = _str_lut_bool(
+                ctx, operand, lambda s: s in opts,
+                kind=f"inlist:{sorted(opts)!r}",
+            )
         else:
             operand = _coerce_num(ctx, operand)
             hit = None
@@ -215,10 +233,16 @@ def eval_expression(expr: Expr, ctx: EvalContext) -> Val:
             raise ExprEvalError("LIKE requires a string column")
         if expr.regex:
             rx = re.compile(expr.pattern)
-            res = _str_lut_bool(ctx, operand, lambda s: rx.search(s) is not None)
+            res = _str_lut_bool(
+                ctx, operand, lambda s: rx.search(s) is not None,
+                kind=f"rlike:{expr.pattern}",
+            )
         else:
             rx = re.compile(_like_to_regex(expr.pattern), re.DOTALL)
-            res = _str_lut_bool(ctx, operand, lambda s: rx.match(s) is not None)
+            res = _str_lut_bool(
+                ctx, operand, lambda s: rx.match(s) is not None,
+                kind=f"like:{expr.pattern}",
+            )
         if expr.negated:
             return Val("bool", ~_asbool(xp, res.data), res.mask)
         return res
@@ -323,9 +347,13 @@ def _eval_binary(expr: BinaryOp, ctx: EvalContext) -> Val:
         if _is_str_col(a) and _is_str_col(b):
             res = _str_cols_cmp(ctx, a, b, "=")
         elif a.kind == "str" and a.dictionary is not None and b.kind == "str" and b.dictionary is None:
-            res = _str_lut_bool(ctx, a, lambda s, t=b.data: s == t)
+            res = _str_lut_bool(
+                ctx, a, lambda s, t=b.data: s == t, kind=f"eq:{b.data!r}"
+            )
         elif b.kind == "str" and b.dictionary is not None and a.kind == "str" and a.dictionary is None:
-            res = _str_lut_bool(ctx, b, lambda s, t=a.data: s == t)
+            res = _str_lut_bool(
+                ctx, b, lambda s, t=a.data: s == t, kind=f"eq:{a.data!r}"
+            )
         else:
             an = _coerce_num(ctx, a)
             bn = _coerce_num(ctx, b)
@@ -341,7 +369,7 @@ def _eval_binary(expr: BinaryOp, ctx: EvalContext) -> Val:
             t = b.data
             fns = {"<": lambda s: s < t, "<=": lambda s: s <= t,
                    ">": lambda s: s > t, ">=": lambda s: s >= t}
-            return _str_lut_bool(ctx, a, fns[op])
+            return _str_lut_bool(ctx, a, fns[op], kind=f"cmp{op}:{t!r}")
         an = _coerce_num(ctx, a)
         bn = _coerce_num(ctx, b)
         fn = {"<": xp.less, "<=": xp.less_equal,
@@ -392,9 +420,15 @@ def _eval_fn(expr: FnCall, ctx: EvalContext) -> Val:
         v = eval_expression(expr.args[0], ctx)
         if v.kind != "str" or v.dictionary is None:
             raise ExprEvalError("length() requires a string column")
-        lut = np.array([len(s) for s in v.dictionary], dtype=np.float64)
-        if len(lut) == 0:
-            lut = np.zeros(1)
+        from deequ_tpu.ops.lut_cache import dictionary_lut
+
+        # kind "len" counts characters; scan.py's "utf8len" counts bytes
+        lut = dictionary_lut(
+            v.dictionary, "len",
+            lambda d: np.array([len(s) for s in d], dtype=np.float64)
+            if len(d)
+            else np.zeros(1),
+        )
         safe = xp.maximum(v.data, 0)
         return Val("num", xp.asarray(lut)[safe], v.data >= 0)
     raise ExprEvalError(f"unknown function {expr.name}")
