@@ -389,14 +389,49 @@ class Histogram(FrequencyBasedAnalyzer):
 
         return [param_check, has_column(self.column)]
 
+    def _binned_column(self, col):
+        """Apply the binning UDF once per DISTINCT value (O(cardinality)
+        host work, like every other per-distinct string op) and remap the
+        row codes — not once per row as the reference's UDF does
+        (Histogram.scala:41-117). Bin labels are stringified immediately:
+        the metric stringifies groups anyway, so grouping by the
+        stringified label yields the identical Distribution."""
+        from deequ_tpu.data.table import Column
+        from deequ_tpu.ops.segment import column_key_codes
+
+        codes, distinct = column_key_codes(col)  # 0 = null
+        # the UDF runs only on values some valid row actually references —
+        # string dictionaries may hold placeholder entries (e.g. "" for
+        # null slots) the reference's per-row UDF would never see
+        referenced = np.zeros(len(distinct), dtype=bool)
+        valid_codes = codes[codes > 0] - 1
+        referenced[valid_codes] = True
+        labels = np.array(
+            [
+                _stringify(self.binning_udf(v)) if referenced[i] else ""
+                for i, v in enumerate(distinct)
+            ],
+            dtype=object,
+        )
+        if len(labels):
+            uniq, inv = np.unique(labels.astype(str), return_inverse=True)
+        else:
+            uniq, inv = np.array([], dtype=object), np.array([], dtype=np.int64)
+        new_codes = np.where(
+            codes > 0,
+            inv[np.maximum(codes - 1, 0)] if len(inv) else 0,
+            -1,
+        ).astype(np.int32)
+        return Column(
+            col.name, DType.STRING, codes=new_codes,
+            dictionary=uniq.astype(object),
+        )
+
     def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
         total_count = table.num_rows
         col = table[self.column]
         if self.binning_udf is not None:
-            binned = [
-                None if v is None else self.binning_udf(v) for v in col.to_pylist()
-            ]
-            binned_table = ColumnarTable.from_pydict({self.column: binned})
+            binned_table = ColumnarTable([self._binned_column(col)])
             freqs, _ = group_counts(
                 binned_table, [self.column], require_any_non_null=False
             )

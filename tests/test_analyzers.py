@@ -377,3 +377,37 @@ def test_histogram_nullvalue_literal_merges_with_nulls():
     assert fast.values["NullValue"].absolute == 3
     assert slow.values["NullValue"].absolute == 3
     assert fast.values == slow.values
+
+
+def test_histogram_binning_udf_per_distinct():
+    """Binning UDFs apply once per distinct value and group by the
+    stringified bin label — results must match the reference semantics
+    (bin, stringify, count all rows incl. nulls)."""
+    import numpy as np
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    # string column with nulls
+    vals = (["apple", "avocado", "banana", None, "cherry", "apple"])
+    t = ColumnarTable.from_pydict({"s": vals})
+    h = Histogram("s", binning_udf=lambda v: v[0].upper())
+    dist = h.calculate(t).value.get()
+    assert dist.values["A"].absolute == 3
+    assert dist.values["B"].absolute == 1
+    assert dist.values["C"].absolute == 1
+    assert dist.values["NullValue"].absolute == 1
+    assert dist.number_of_bins == 4
+
+    # numeric column binned into ranges; ratio uses ALL rows
+    nums = np.array([1.0, 2.0, 11.0, 12.0, 25.0])
+    t2 = ColumnarTable([Column("x", DType.FRACTIONAL, values=nums)])
+    h2 = Histogram("x", binning_udf=lambda v: "low" if v < 10 else "high")
+    d2 = h2.calculate(t2).value.get()
+    assert d2.values["low"].absolute == 2
+    assert d2.values["high"].absolute == 3
+    assert d2.values["low"].ratio == 2 / 5
+
+    # udf returning non-strings stringifies like the reference's cast
+    h3 = Histogram("x", binning_udf=lambda v: int(v // 10))
+    d3 = h3.calculate(t2).value.get()
+    assert set(d3.values) == {"0", "1", "2"}
+    assert d3.values["1"].absolute == 2
