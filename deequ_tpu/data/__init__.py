@@ -1,6 +1,7 @@
 from deequ_tpu.data.table import Column, ColumnarTable, DType, Schema
 from deequ_tpu.data.source import (
     BatchSource,
+    CSVBatchSource,
     GeneratorBatchSource,
     ParquetBatchSource,
     TableBatchSource,
@@ -13,6 +14,7 @@ __all__ = [
     "DType",
     "Schema",
     "BatchSource",
+    "CSVBatchSource",
     "GeneratorBatchSource",
     "ParquetBatchSource",
     "TableBatchSource",

@@ -196,6 +196,15 @@ def stream_parquet(paths, columns=None, batch_rows=None):
     return StreamingTable(ParquetBatchSource(paths, columns, batch_rows))
 
 
+def stream_csv(path, columns=None, batch_rows=None, delimiter=","):
+    """Open a CSV file as a StreamingTable (pyarrow's incremental C++
+    parser; the file is never materialized)."""
+    from deequ_tpu.data.source import CSVBatchSource
+    from deequ_tpu.data.streaming import StreamingTable
+
+    return StreamingTable(CSVBatchSource(path, columns, batch_rows, delimiter))
+
+
 def from_pandas(df) -> ColumnarTable:
     """Convert a pandas DataFrame."""
     import pandas as pd

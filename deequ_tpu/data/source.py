@@ -55,6 +55,17 @@ class BatchSource:
         raise NotImplementedError
 
 
+def _restrict_arrow_schema(arrow_schema, names, what: str):
+    """Map requested column names onto an arrow schema -> engine Fields."""
+    fields = []
+    for name in names:
+        idx = arrow_schema.get_field_index(name)
+        if idx < 0:
+            raise ValueError(f"column {name!r} not in {what}")
+        fields.append(Field(name, _arrow_field_dtype(arrow_schema.field(idx).type)))
+    return fields
+
+
 def _arrow_field_dtype(pa_type) -> DType:
     import pyarrow as pa
 
@@ -103,13 +114,9 @@ class ParquetBatchSource(BatchSource):
             if self._restrict is not None
             else list(arrow_schema.names)
         )
-        fields = []
-        for name in names:
-            idx = arrow_schema.get_field_index(name)
-            if idx < 0:
-                raise ValueError(f"column {name!r} not in parquet schema")
-            fields.append(Field(name, _arrow_field_dtype(arrow_schema.field(idx).type)))
-        self._schema = Schema(fields)
+        self._schema = Schema(
+            _restrict_arrow_schema(arrow_schema, names, "parquet schema")
+        )
         n = first.metadata.num_rows
         for path in self.paths[1:]:
             n += pq.ParquetFile(path).metadata.num_rows
@@ -142,6 +149,158 @@ class ParquetBatchSource(BatchSource):
             pf = pq.ParquetFile(path, pre_buffer=self.pre_buffer)
             for record_batch in pf.iter_batches(batch_size=rows, columns=names):
                 yield from_arrow(pa.Table.from_batches([record_batch]))
+
+
+class CSVBatchSource(BatchSource):
+    """Stream a CSV file as ColumnarTable batches via pyarrow's streaming
+    CSV reader (C++ incremental parser; the file is never materialized).
+
+    Null semantics match ``read_csv``: ONLY the empty cell is null
+    (strings stay strings — 'NA'/'nan' literals are data, not nulls).
+
+    Schema: ``column_types`` pins dtypes directly (the bounded-memory
+    path for huge files); otherwise one streaming schema pass infers each
+    column's widened type over the WHOLE file (int64 -> float64 ->
+    string), so a value late in the file can never crash the analysis
+    the way a sampled-prefix schema would."""
+
+    def __init__(
+        self,
+        path: str,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+        delimiter: str = ",",
+        column_types: Optional[Dict[str, DType]] = None,
+    ):
+        import pyarrow as pa
+
+        self.path = path
+        self.delimiter = delimiter
+        self._restrict = list(columns) if columns is not None else None
+        self._batch_rows = batch_rows
+        if column_types is not None:
+            arrow_of = {
+                DType.INTEGRAL: pa.int64(),
+                DType.FRACTIONAL: pa.float64(),
+                DType.BOOLEAN: pa.bool_(),
+                DType.STRING: pa.string(),
+            }
+            header = self._open(block_rows=1 << 12).schema
+            pinned = {
+                name: arrow_of[column_types.get(name, DType.STRING)]
+                for name in header.names
+            }
+            arrow_schema = pa.schema(
+                [pa.field(n, pinned[n]) for n in header.names]
+            )
+        else:
+            arrow_schema = self._infer_schema_streaming()
+        names = (
+            self._restrict
+            if self._restrict is not None
+            else list(arrow_schema.names)
+        )
+        self._schema = Schema(
+            _restrict_arrow_schema(arrow_schema, names, "CSV header")
+        )
+        self._arrow_schema = arrow_schema
+
+    def _infer_schema_streaming(self):
+        """One streaming pass over the file, widening each column's type
+        across blocks (bounded memory; reads the file once for schema)."""
+        import pyarrow as pa
+
+        rank = {}  # name -> widen rank; bool tracked separately
+        is_bool = {}
+        for record_batch in self._open(block_rows=1 << 16):
+            for field in record_batch.schema:
+                t = field.type
+                if pa.types.is_boolean(t):
+                    r, b = 0, True
+                elif pa.types.is_integer(t):
+                    r, b = 0, False
+                elif pa.types.is_floating(t):
+                    r, b = 1, False
+                elif pa.types.is_null(t):
+                    continue  # all-null block: no information
+                else:
+                    r, b = 2, False
+                prev = rank.get(field.name)
+                if prev is None:
+                    rank[field.name] = r
+                    is_bool[field.name] = b
+                else:
+                    if b != is_bool[field.name]:
+                        # bool mixed with anything else -> string
+                        rank[field.name] = 2
+                        is_bool[field.name] = False
+                    else:
+                        rank[field.name] = max(prev, r)
+        header = self._open(block_rows=1 << 12).schema
+        out = []
+        for name in header.names:
+            r = rank.get(name)
+            if r is None:
+                t = pa.string()  # all-null column
+            elif is_bool.get(name):
+                t = pa.bool_()
+            else:
+                t = (pa.int64(), pa.float64(), pa.string())[r]
+            out.append(pa.field(name, t))
+        return pa.schema(out)
+
+    def _open(self, block_rows: int, pin_schema=None, include=None):
+        import pyarrow.csv as pacsv
+
+        # block size in bytes; estimate ~64 bytes/row as a coarse default
+        block_bytes = max(block_rows * 64, 1 << 16)
+        convert = pacsv.ConvertOptions(
+            column_types=dict(zip(pin_schema.names, pin_schema.types))
+            if pin_schema is not None
+            else None,
+            include_columns=include if include is not None else self._restrict,
+            # read_csv parity: ONLY the empty cell is null, and it is null
+            # in string columns too
+            null_values=[""],
+            strings_can_be_null=True,
+        )
+        return pacsv.open_csv(
+            self.path,
+            read_options=pacsv.ReadOptions(block_size=block_bytes),
+            parse_options=pacsv.ParseOptions(delimiter=self.delimiter),
+            convert_options=convert,
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def num_rows(self) -> Optional[int]:
+        return None  # CSV has no row-count metadata; Size() measures it
+
+    def batches(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        import pyarrow as pa
+
+        from deequ_tpu.data.io import from_arrow
+
+        keep = (
+            [n for n in self._schema.column_names if n in set(columns)]
+            if columns is not None
+            else None
+        )
+        rows = batch_rows or self._batch_rows or batch_rows_for_schema(self._schema)
+        # pruning happens in the reader: pyarrow skips conversion of
+        # excluded columns entirely
+        reader = self._open(
+            block_rows=rows, pin_schema=self._arrow_schema, include=keep
+        )
+        for record_batch in reader:
+            yield from_arrow(pa.Table.from_batches([record_batch]))
 
 
 class TableBatchSource(BatchSource):
