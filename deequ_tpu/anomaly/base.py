@@ -33,9 +33,17 @@ class DetectionResult:
     anomalies: List[Tuple[int, Anomaly]] = field(default_factory=list)
 
 
+# "whole series" sentinel for detect()'s search interval — the analogue of
+# the reference trait's (Int.MinValue, Int.MaxValue) default
+# (AnomalyDetectionStrategy.scala:20-29)
+FULL_INTERVAL = (0, 2 ** 63 - 1)
+
+
 class AnomalyDetectionStrategy:
     def detect(
-        self, data_series: Sequence[float], search_interval: Tuple[int, int]
+        self,
+        data_series: Sequence[float],
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> List[Tuple[int, Anomaly]]:
         raise NotImplementedError
 
@@ -69,7 +77,7 @@ class AnomalyDetector:
     def detect_anomalies_in_history(
         self,
         data_series: Sequence[DataPoint],
-        search_interval: Tuple[int, int] = (-(2 ** 63), 2 ** 63 - 1),
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> DetectionResult:
         search_start, search_end = search_interval
         if search_start > search_end:

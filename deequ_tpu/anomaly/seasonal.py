@@ -20,7 +20,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deequ_tpu.anomaly.base import Anomaly, AnomalyDetectionStrategy
+from deequ_tpu.anomaly.base import FULL_INTERVAL, Anomaly, AnomalyDetectionStrategy
 
 
 class MetricInterval(enum.Enum):
@@ -147,8 +147,14 @@ class HoltWinters(AnomalyDetectionStrategy):
                 "or (Monthly metrics, Yearly seasonality)"
             )
 
+    # NOTE: like the reference (HoltWinters.scala), detect() requires a
+    # search interval leaving >= two full seasonal cycles of training data
+    # BEFORE the interval; the defaulted trait signature (start=0) raises
+    # by construction and is kept only for API parity.
     def detect(
-        self, data_series: Sequence[float], search_interval: Tuple[int, int]
+        self,
+        data_series: Sequence[float],
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> List[Tuple[int, Anomaly]]:
         if len(data_series) == 0:
             raise ValueError("Provided data series is empty")

@@ -8,7 +8,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deequ_tpu.anomaly.base import Anomaly, AnomalyDetectionStrategy
+from deequ_tpu.anomaly.base import FULL_INTERVAL, Anomaly, AnomalyDetectionStrategy
 
 _DOUBLE_MIN = -float("inf")
 _DOUBLE_MAX = float("inf")
@@ -47,7 +47,9 @@ class BaseChangeStrategy(AnomalyDetectionStrategy):
         return self.diff(series[1:] - series[:-1], order - 1)
 
     def detect(
-        self, data_series: Sequence[float], search_interval: Tuple[int, int]
+        self,
+        data_series: Sequence[float],
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> List[Tuple[int, Anomaly]]:
         start, end = search_interval
         if start > end:
@@ -116,7 +118,9 @@ class SimpleThresholdStrategy(AnomalyDetectionStrategy):
             raise ValueError("The lower bound must be smaller or equal to the upper bound.")
 
     def detect(
-        self, data_series: Sequence[float], search_interval: Tuple[int, int]
+        self,
+        data_series: Sequence[float],
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> List[Tuple[int, Anomaly]]:
         start, end = search_interval
         if start > end:
@@ -165,7 +169,7 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
     def compute_stats_and_anomalies(
         self,
         data_series: Sequence[float],
-        search_interval: Tuple[int, int] = (0, 2 ** 31 - 1),
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ):
         results = []
         current_mean = 0.0
@@ -210,7 +214,9 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
         return results
 
     def detect(
-        self, data_series: Sequence[float], search_interval: Tuple[int, int]
+        self,
+        data_series: Sequence[float],
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> List[Tuple[int, Anomaly]]:
         search_start, search_end = search_interval
         if search_start > search_end:
@@ -248,6 +254,10 @@ class OnlineNormalStrategy(AnomalyDetectionStrategy):
 
 @dataclass
 class BatchNormalStrategy(AnomalyDetectionStrategy):
+    # NOTE: like the reference (BatchNormalStrategy.scala:33-95), calling
+    # detect() without an explicit search interval raises — the strategy
+    # needs values OUTSIDE the interval to train on. The defaulted trait
+    # signature is kept for API parity.
     """Mean/stddev estimated from values outside (or including) the search
     interval; z-score bounds on the interval
     (reference BatchNormalStrategy.scala:33-95). Uses sample stddev (ddof=1)
@@ -266,7 +276,9 @@ class BatchNormalStrategy(AnomalyDetectionStrategy):
             raise ValueError("Factors cannot be smaller than zero.")
 
     def detect(
-        self, data_series: Sequence[float], search_interval: Tuple[int, int]
+        self,
+        data_series: Sequence[float],
+        search_interval: Tuple[int, int] = FULL_INTERVAL,
     ) -> List[Tuple[int, Anomaly]]:
         search_start, search_end = search_interval
         if search_start > search_end:

@@ -165,3 +165,87 @@ def test_anomaly_check_integration(df_with_numeric_values):
         .run()
     )
     assert result2.status == CheckStatus.WARNING
+
+
+def test_online_normal_exact_indices_reference_pattern():
+    """The reference's OnlineNormalStrategyTest pattern: a gaussian series
+    with spikes at indices 20..30 (even = +i, odd = -i); exact anomaly
+    index sets per deviation-factor configuration
+    (OnlineNormalStrategyTest.scala:27-80)."""
+    import numpy as np
+
+    from deequ_tpu.anomaly import OnlineNormalStrategy
+
+    rng = np.random.default_rng(1)
+    data = list(rng.normal(0, 1, 51))
+    for i in range(20, 31):
+        data[i] += i + (i % 2) * -2 * i
+
+    # generous factor: exactly the spiked indices
+    s = OnlineNormalStrategy(
+        lower_deviation_factor=3.5, upper_deviation_factor=3.5,
+        ignore_start_percentage=0.2,
+    )
+    assert [i for i, _ in s.detect(data)] == list(range(20, 31))
+
+    # interval restriction
+    s2 = OnlineNormalStrategy(
+        lower_deviation_factor=1.5, upper_deviation_factor=1.5,
+        ignore_start_percentage=0.2,
+    )
+    assert [i for i, _ in s2.detect(data, (25, 31))] == list(range(25, 31))
+
+    # upper-only: positive spikes (even indices)
+    up = OnlineNormalStrategy(
+        lower_deviation_factor=None, upper_deviation_factor=1.5,
+        ignore_start_percentage=0.2,
+    )
+    assert [i for i, _ in up.detect(data)] == list(range(20, 31, 2))
+
+    # lower-only: negative spikes (odd indices)
+    lo = OnlineNormalStrategy(
+        lower_deviation_factor=1.5, upper_deviation_factor=None,
+        ignore_start_percentage=0.2,
+    )
+    assert [i for i, _ in lo.detect(data)] == list(range(21, 30, 2))
+
+
+def test_absolute_change_exact_indices():
+    """AbsoluteChangeStrategyTest pattern: exact indices for first- and
+    second-order differences with one-sided bounds."""
+    from deequ_tpu.anomaly import AbsoluteChangeStrategy
+
+    data = [1.0] * 10 + [10.0] + [1.0] * 10  # spike at 10
+    up = AbsoluteChangeStrategy(max_rate_increase=5.0)
+    assert [i for i, _ in up.detect(data)] == [10]
+    down = AbsoluteChangeStrategy(max_rate_decrease=-5.0)
+    assert [i for i, _ in down.detect(data)] == [11]
+    both = AbsoluteChangeStrategy(
+        max_rate_decrease=-5.0, max_rate_increase=5.0
+    )
+    assert [i for i, _ in both.detect(data)] == [10, 11]
+
+
+def test_relative_rate_exact_indices():
+    from deequ_tpu.anomaly import RelativeRateOfChangeStrategy
+
+    data = [1.0, 1.0, 4.0, 4.0, 1.0, 1.0]
+    s = RelativeRateOfChangeStrategy(max_rate_increase=2.0, max_rate_decrease=0.5)
+    assert [i for i, _ in s.detect(data)] == [2, 4]
+
+
+def test_batch_normal_exact_indices():
+    from deequ_tpu.anomaly import BatchNormalStrategy
+
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    data = list(rng.normal(10, 1, 30))
+    data.append(25.0)
+    data.append(10.2)
+    s = BatchNormalStrategy(
+        lower_deviation_factor=5.0, upper_deviation_factor=5.0
+    )
+    # train on the clean prefix, search the tail
+    result = s.detect(data, (30, 32))
+    assert [i for i, _ in result] == [30]
