@@ -119,7 +119,25 @@ class ParquetBatchSource(BatchSource):
         )
         n = first.metadata.num_rows
         for path in self.paths[1:]:
-            n += pq.ParquetFile(path).metadata.num_rows
+            pf = pq.ParquetFile(path)
+            # compare only the SELECTED fields, by name: batches() reads
+            # columns by name per file, so extra/reordered unselected
+            # columns in a later file are fine — a selected column that
+            # is missing or type-changed is not
+            other = pf.schema_arrow
+            for name in names:
+                idx = other.get_field_index(name)
+                if idx < 0 or not other.field(idx).type.equals(
+                    arrow_schema.field(name).type
+                ):
+                    raise ValueError(
+                        f"parquet schema mismatch: column {name!r} in "
+                        f"{path!r} is "
+                        f"{other.field(idx).type if idx >= 0 else 'missing'},"
+                        f" expected {arrow_schema.field(name).type} "
+                        f"(from {self.paths[0]!r})"
+                    )
+            n += pf.metadata.num_rows
         self._num_rows = int(n)
 
     @property
@@ -149,6 +167,33 @@ class ParquetBatchSource(BatchSource):
             pf = pq.ParquetFile(path, pre_buffer=self.pre_buffer)
             for record_batch in pf.iter_batches(batch_size=rows, columns=names):
                 yield from_arrow(pa.Table.from_batches([record_batch]))
+
+
+# bool literals per pyarrow CSV inference, minus "0"/"1" which the int
+# cast already claims (matching open_csv, where int64 is tried first)
+_BOOL_LITERALS = frozenset({"true", "false"})
+
+
+def _classify_string_values(col):
+    """Classify a non-null string array -> (widen rank, is_bool), with
+    the same lattice as pyarrow CSV inference: int64(0) < float64(1) <
+    string(2); bool is rank 0 tracked separately."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    try:
+        pc.cast(col, pa.int64())
+        return 0, False
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        pass
+    lowered = set(pc.utf8_lower(col).unique().to_pylist())
+    if lowered <= _BOOL_LITERALS:
+        return 0, True
+    try:
+        pc.cast(col, pa.float64())
+        return 1, False
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return 2, False
 
 
 class CSVBatchSource(BatchSource):
@@ -207,36 +252,43 @@ class CSVBatchSource(BatchSource):
 
     def _infer_schema_streaming(self):
         """One streaming pass over the file, widening each column's type
-        across blocks (bounded memory; reads the file once for schema)."""
+        across blocks (bounded memory; reads the file once for schema).
+
+        Every column is READ as string and classified on host: pyarrow's
+        open_csv pins each column's type from its first block, so letting
+        it infer would raise ArrowInvalid on a type-widening value in any
+        later block (e.g. a '3.5' past the first ~4MB of an int column) —
+        the exact failure this pass exists to prevent."""
         import pyarrow as pa
 
+        header = self._open(block_rows=1 << 12).schema
+        all_string = pa.schema(
+            [pa.field(n, pa.string()) for n in header.names]
+        )
         rank = {}  # name -> widen rank; bool tracked separately
         is_bool = {}
-        for record_batch in self._open(block_rows=1 << 16):
-            for field in record_batch.schema:
-                t = field.type
-                if pa.types.is_boolean(t):
-                    r, b = 0, True
-                elif pa.types.is_integer(t):
-                    r, b = 0, False
-                elif pa.types.is_floating(t):
-                    r, b = 1, False
-                elif pa.types.is_null(t):
+        for record_batch in self._open(
+            block_rows=1 << 16, pin_schema=all_string
+        ):
+            for i, field in enumerate(record_batch.schema):
+                name = field.name
+                if rank.get(name) == 2:
+                    continue  # already string; cannot widen further
+                col = record_batch.column(i).drop_null()
+                if len(col) == 0:
                     continue  # all-null block: no information
-                else:
-                    r, b = 2, False
-                prev = rank.get(field.name)
+                r, b = _classify_string_values(col)
+                prev = rank.get(name)
                 if prev is None:
-                    rank[field.name] = r
-                    is_bool[field.name] = b
+                    rank[name] = r
+                    is_bool[name] = b
                 else:
-                    if b != is_bool[field.name]:
+                    if b != is_bool[name]:
                         # bool mixed with anything else -> string
-                        rank[field.name] = 2
-                        is_bool[field.name] = False
+                        rank[name] = 2
+                        is_bool[name] = False
                     else:
-                        rank[field.name] = max(prev, r)
-        header = self._open(block_rows=1 << 12).schema
+                        rank[name] = max(prev, r)
         out = []
         for name in header.names:
             r = rank.get(name)

@@ -105,6 +105,13 @@ class ScanOp:
     # merged by the planner into ONE vectorized op (e.g. N same-parameter
     # KLL sorts -> one vmapped batched sort). Shape: (kind, params, column).
     batch_hint: Optional[Tuple] = None
+    # optional host-side compaction of the accumulated partial: called by
+    # the folder whenever a 'gather' leaf exceeds compact_threshold rows,
+    # returning an equivalent pytree of bounded size (e.g. KLL folds the
+    # gathered weighted items into a sketch and re-emits its weighted
+    # items). Keeps host memory O(1) in chunk count on TB-scale streams.
+    compact: Optional[Callable[[Any], Any]] = None
+    compact_threshold: int = 1 << 20
 
 
 class ScanStats:
@@ -715,10 +722,24 @@ class _PartialFolder:
         if self.merged is None:
             self.merged = list(partials)
         else:
-            self.merged = [
-                jax.tree.map(_tag_reduce_np, op.tags, acc, p)
-                for op, acc, p in zip(self.ops, self.merged, partials)
-            ]
+            out = []
+            for op, acc, p in zip(self.ops, self.merged, partials):
+                m = jax.tree.map(_tag_reduce_np, op.tags, acc, p)
+                if op.compact is not None:
+                    gathered = max(
+                        (
+                            np.size(leaf)
+                            for tag, leaf in zip(
+                                jax.tree.leaves(op.tags), jax.tree.leaves(m)
+                            )
+                            if tag == "gather"
+                        ),
+                        default=0,
+                    )
+                    if gathered > op.compact_threshold:
+                        m = op.compact(m)
+                out.append(m)
+            self.merged = out
 
 
 def run_scan(
@@ -879,13 +900,22 @@ def _prefetch(iterator, depth: int = 2):
                         continue
                 if stop.is_set():
                     return
-            q.put(DONE)
-        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
-            if not stop.is_set():
+            while not stop.is_set():
                 try:
-                    q.put(e, timeout=1.0)
+                    q.put(DONE, timeout=0.1)
+                    break
                 except queue.Full:
-                    pass
+                    continue
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
+            # same stop-checked retry as items: a single timed put could
+            # drop the exception while the consumer is busy packing a
+            # large chunk, leaving it blocked on q.get() forever
+            while not stop.is_set():
+                try:
+                    q.put(e, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     t = threading.Thread(target=run, daemon=True, name="deequ-tpu-prefetch")
     t.start()

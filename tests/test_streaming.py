@@ -310,3 +310,186 @@ def test_stream_csv_null_and_widening_semantics(tmp_path):
     # widened column is usable as numeric downstream
     st = stream_csv(path, batch_rows=8_000)
     assert st["score"].dtype.name == "STRING"  # 'NA' forces string, like read_csv
+
+
+def test_stream_csv_multiblock_widening(tmp_path):
+    """ADVICE r3 (high): the inference pass must survive a type-widening
+    value PAST the first reader block. pyarrow's open_csv pins each
+    column's type from its first ~4MB block, so the schema pass now reads
+    every column as string and widens on host — a late '3.5' in an int
+    column must widen to float, not raise ArrowInvalid."""
+    from deequ_tpu.analyzers import Completeness, Mean, Size
+    from deequ_tpu.data.io import read_csv, stream_csv
+
+    path = str(tmp_path / "big.csv")
+    with open(path, "w") as f:
+        f.write("id,score,flag\n")
+        # ~6MB: well past the 4MB inference block; int-looking until the end
+        for i in range(400_000):
+            f.write(f"{i},{i % 1000},true\n")
+        f.write("400000,3.5,false\n")  # float only in the LAST block
+
+    st = stream_csv(path, batch_rows=100_000)
+    assert st["score"].dtype.name == "FRACTIONAL"
+    assert st["flag"].dtype.name == "BOOLEAN"
+
+    analyzers = [Size(), Completeness("score"), Mean("score")]
+    mem = AnalysisRunner.do_analysis_run(read_csv(path), analyzers)
+    stream = AnalysisRunner.do_analysis_run(st, analyzers)
+    for a in analyzers:
+        assert stream.metric_map[a].value.get() == pytest.approx(
+            mem.metric_map[a].value.get(), rel=1e-12
+        ), a
+
+
+def test_prefetch_delivers_late_exception():
+    """ADVICE r3 (medium): a reader-thread exception raised while the
+    queue is full must reach the consumer even when the consumer takes
+    longer than any single put timeout to free a slot (previously the
+    1s-timeout put dropped the exception and the consumer hung forever)."""
+    import time
+
+    from deequ_tpu.ops.scan_engine import _prefetch
+
+    def source():
+        yield 1
+        yield 2  # fills the depth-1 queue while the consumer sleeps
+        raise RuntimeError("reader died")
+
+    gen = _prefetch(source(), depth=1)
+    assert next(gen) == 1
+    time.sleep(1.5)  # consumer stalls past the old 1.0s put timeout
+    assert next(gen) == 2
+    with pytest.raises(RuntimeError, match="reader died"):
+        next(gen)
+
+
+def test_parquet_source_rejects_schema_mismatch(tmp_path):
+    """ADVICE r3 (low): a later file with a different schema fails at
+    construction with a clear error, not deep inside packing."""
+    from deequ_tpu.data.source import ParquetBatchSource
+
+    a = str(tmp_path / "a.parquet")
+    b = str(tmp_path / "b.parquet")
+    write_parquet(ColumnarTable.from_pydict({"x": [1, 2], "y": [1.0, 2.0]}), a)
+    write_parquet(ColumnarTable.from_pydict({"x": [1, 2], "y": ["s", "t"]}), b)
+    ParquetBatchSource([a, a])  # identical schemas are fine
+    with pytest.raises(ValueError, match="schema mismatch"):
+        ParquetBatchSource([a, b])
+
+
+def test_kll_midscan_compaction_bounds_gather():
+    """ADVICE r3 (medium): gathered KLL summaries fold into a bounded
+    sketch mid-scan instead of accumulating one summary per chunk on
+    host. Quantiles with compaction must track the uncompacted fold."""
+    from deequ_tpu.analyzers.sketches import _make_kll_compact
+    from deequ_tpu.ops.kll_device import fold_summaries
+
+    rng = np.random.default_rng(5)
+    k = 256
+    # simulate 64 gathered chunk summaries of 64 weight-4 strata each
+    items = rng.normal(50.0, 10.0, (64, 64)).ravel()
+    weights = np.full(64 * 64, 4.0)
+    result = {"items": items, "weights": weights,
+              "count": np.float64(items.size * 4), "min": items.min(),
+              "max": items.max()}
+
+    compacted = _make_kll_compact(1, k)(result)
+    assert compacted["items"].size < items.size  # actually bounded
+    assert compacted["weights"].sum() == weights.sum()  # total weight exact
+
+    ref = fold_summaries(items, weights, k, 0.64)
+    got = fold_summaries(compacted["items"], compacted["weights"], k, 0.64)
+    assert got.count == ref.count
+    for q in (0.1, 0.5, 0.9):
+        # both are ~1/k-accurate rank estimates of the same stream
+        assert abs(got.quantile(q) - ref.quantile(q)) < 2.0
+
+
+def test_kll_multi_compact_preserves_extraction_layout():
+    """Coalesced (batched) KLL ops gather (n_chunks*K, T) blocks and
+    extract column j at rows j::K — compaction must preserve that layout
+    and the trailing dim so later chunks still concatenate."""
+    from deequ_tpu.analyzers.sketches import (
+        _kll_multi_extract,
+        _make_kll_compact,
+    )
+    from deequ_tpu.ops.kll_device import fold_summaries
+
+    rng = np.random.default_rng(6)
+    K, T, chunks, k = 3, 32, 40, 128
+    # column j's values centered at 100*j so mixing layouts is detectable
+    items = np.zeros((chunks * K, T))
+    weights = np.full((chunks * K, T), 2.0)
+    for j in range(K):
+        items[j::K] = rng.normal(100.0 * (j + 1), 5.0, (chunks, T))
+    result = {"items": items, "weights": weights,
+              "count": np.full(K, chunks * T * 2.0),
+              "min": items.min(axis=0), "max": items.max(axis=0)}
+
+    compacted = _make_kll_compact(K, k)(result)
+    assert compacted["items"].shape[-1] == T  # trailing dim preserved
+    assert compacted["items"].shape[0] % K == 0
+    assert compacted["items"].shape[0] < chunks * K
+    for j in range(K):
+        ex = _kll_multi_extract(compacted, j, K)
+        sk = fold_summaries(ex["items"], ex["weights"], k, 0.64)
+        # median lands near column j's center -> layout survived
+        assert abs(sk.quantile(0.5) - 100.0 * (j + 1)) < 5.0
+        assert sk.count == chunks * T * 2
+
+
+def test_kll_compaction_in_streaming_scan(tmp_path):
+    """End-to-end: the _PartialFolder applies op.compact during a
+    many-chunk streaming scan (threshold lowered to force it), and the
+    resulting quantiles match the uncompacted scan closely."""
+    from deequ_tpu.analyzers.sketches import _kll_scan_op, _kll_state_from_result
+    from deequ_tpu.ops.scan_engine import run_scan
+
+    rng = np.random.default_rng(7)
+    n = 60_000
+    table = ColumnarTable.from_pydict({"v": rng.normal(0.0, 1.0, n).tolist()})
+    path = str(tmp_path / "v.parquet")
+    write_parquet(table, path)
+
+    def scan(threshold):
+        st = stream_parquet(path, batch_rows=2_000)
+        op = _kll_scan_op(st, "v", 256)
+        if threshold is not None:
+            op.compact_threshold = threshold
+        (result,) = run_scan(st, [op], chunk_rows=2_000)
+        return _kll_state_from_result(result, 256, 0.64)
+
+    compacted = scan(threshold=2_000)   # forces many mid-scan folds
+    plain = scan(threshold=None)
+    assert compacted.sketch.count == plain.sketch.count == n
+    for q in (0.05, 0.5, 0.95):
+        assert abs(compacted.sketch.quantile(q) - plain.sketch.quantile(q)) < 0.1
+
+
+def test_parquet_source_mismatch_scoped_to_selected_columns(tmp_path):
+    """The per-file schema check only covers SELECTED columns, by name:
+    extra/reordered unselected columns in a later file stream fine."""
+    from deequ_tpu.data.source import ParquetBatchSource
+
+    a = str(tmp_path / "a.parquet")
+    b = str(tmp_path / "b.parquet")
+    write_parquet(ColumnarTable.from_pydict({"x": [1, 2], "y": [1.0, 2.0]}), a)
+    write_parquet(ColumnarTable.from_pydict({"y": ["s"], "x": [3]}), b)
+    src = ParquetBatchSource([a, b], columns=["x"])  # 'y' differs; unselected
+    total = sum(batch.num_rows for batch in src.batches())
+    assert total == 3
+    with pytest.raises(ValueError, match="schema mismatch"):
+        ParquetBatchSource([a, b])  # selecting 'y' too -> type conflict
+
+
+def test_kll_compact_all_null_column_bounded():
+    """An all-null/fully-filtered KLL column must not keep growing its
+    zero-weight padding through compaction (review r4 finding)."""
+    from deequ_tpu.analyzers.sketches import _make_kll_compact
+
+    result = {"items": np.zeros(10_000), "weights": np.zeros(10_000),
+              "count": np.float64(0), "min": np.inf, "max": -np.inf}
+    compacted = _make_kll_compact(1, 256)(result)
+    assert compacted["items"].size == 0
+    assert compacted["weights"].size == 0
