@@ -230,6 +230,13 @@ class AnalysisRunner:
         op (currently: N same-parameter where-free KLL sorts -> one vmapped
         batched sort, the dominant cost of wide quantile profiles).
 
+        Cross-column batching of the scalar stat ops (mean/min/.../HLL into
+        (K, n) matrix reductions) was tried in round 4 and MEASURED SLOWER
+        on TPU (full 105-analyzer bench: 181ms per-column vs 256ms batched,
+        interleaved best-of-5): the (K, n) stacks materialize copies of
+        buffers XLA otherwise streams per-column, and the XLA scheduler
+        already overlaps the per-column kernels well. Keep ops per-column.
+
         Returns (exec_ops, plan) where plan[i] = (exec_index, extractor or
         None) for scannable[i]."""
         from deequ_tpu.analyzers.sketches import (
@@ -242,7 +249,7 @@ class AnalysisRunner:
         for i, op in enumerate(ops):
             hint = op.batch_hint
             if hint is not None and hint[0] == "kll":
-                groups.setdefault(("kll", hint[1]), []).append(i)
+                groups.setdefault(hint[:2], []).append(i)
 
         mergeable = {
             key: idxs for key, idxs in groups.items() if len(idxs) >= 2
@@ -260,11 +267,11 @@ class AnalysisRunner:
             exec_ops.append(op)
         for (kind, sketch_size), idxs in sorted(mergeable.items()):
             columns = tuple(ops[i].batch_hint[2] for i in idxs)
+            K = len(idxs)
+            exec_idx = len(exec_ops)
             merged = _kll_multi_scan_op(columns, sketch_size)
             merged.cache_key = ("kll_batch", sketch_size, columns)
-            exec_idx = len(exec_ops)
             exec_ops.append(merged)
-            K = len(idxs)
             for j, i in enumerate(idxs):
                 plan[i] = (
                     exec_idx,

@@ -56,6 +56,7 @@ from deequ_tpu.metrics import (
     Entity,
     HistogramMetric,
 )
+from deequ_tpu.ops import df32 as dfops
 from deequ_tpu.ops.scan_engine import ScanOp
 from deequ_tpu.tryresult import Failure, Success
 
@@ -143,7 +144,7 @@ class Size(StandardScanAnalyzer):
         pred, cols = _compile_where(self.where, table)
 
         def update(vals, row_valid, xp, n):
-            return {"n": xp.sum(_rows(vals, row_valid, xp, n, pred))}
+            return {"n": dfops.masked_count(_rows(vals, row_valid, xp, n, pred), xp)}
 
         return ScanOp(
             tuple(sorted(cols)), update, {"n": "sum"},
@@ -174,7 +175,10 @@ class Completeness(StandardScanAnalyzer):
         def update(vals, row_valid, xp, n):
             rows = _rows(vals, row_valid, xp, n, pred)
             matches = rows & _col_mask(vals[col], xp)
-            return {"matches": xp.sum(matches), "count": xp.sum(rows)}
+            return {
+                "matches": dfops.masked_count(matches, xp),
+                "count": dfops.masked_count(rows, xp),
+            }
 
         return ScanOp(
             tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"},
@@ -208,7 +212,10 @@ class Compliance(StandardScanAnalyzer):
         def update(vals, row_valid, xp, n):
             rows = _rows(vals, row_valid, xp, n, pred)
             matches = rows & crit(vals, xp, n)
-            return {"matches": xp.sum(matches), "count": xp.sum(rows)}
+            return {
+                "matches": dfops.masked_count(matches, xp),
+                "count": dfops.masked_count(rows, xp),
+            }
 
         return ScanOp(
             tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"},
@@ -276,7 +283,10 @@ class PatternMatch(StandardScanAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             hit = v.lut(lut_kind)[xp.maximum(v.data, 0)] & (v.data >= 0)
-            return {"matches": xp.sum(rows & hit), "count": xp.sum(rows)}
+            return {
+                "matches": dfops.masked_count(rows & hit, xp),
+                "count": dfops.masked_count(rows, xp),
+            }
 
         return ScanOp(
             tuple(sorted(cols)), update, {"matches": "sum", "count": "sum"},
@@ -307,9 +317,8 @@ class _ExtremumAnalyzer(StandardScanAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             ok = rows & v.mask
-            guarded = xp.where(ok, v.data, identity)
-            agg = xp.min(guarded) if tag == "min" else xp.max(guarded)
-            return {"value": agg, "n": xp.sum(ok)}
+            agg = dfops.masked_extremum(v.data, v.lo, ok, xp, tag)
+            return {"value": agg, "n": dfops.masked_count(ok, xp)}
 
         return ScanOp(
             tuple(sorted(cols)), update, {"value": tag, "n": "sum"},
@@ -361,11 +370,13 @@ class _LengthAnalyzer(StandardScanAnalyzer):
         def build_lut(dictionary):
             from deequ_tpu import native
 
+            # f32 is exact for lengths (< 2^24) and keeps the gathered
+            # plane + min/max on native vector units
             native_lengths = native.utf8_lengths(dictionary)
             if native_lengths is not None:
-                return native_lengths.astype(np.float64)
+                return native_lengths.astype(np.float32)
             return np.array(
-                [float(len(s)) for s in dictionary], dtype=np.float64
+                [float(len(s)) for s in dictionary], dtype=np.float32
             )
 
         def update(vals, row_valid, xp, n):
@@ -373,9 +384,11 @@ class _LengthAnalyzer(StandardScanAnalyzer):
             v = vals[col]
             lengths = v.lut("utf8len")[xp.maximum(v.data, 0)]
             ok = rows & (v.data >= 0)
-            guarded = xp.where(ok, lengths, identity)
-            agg = xp.min(guarded) if tag == "min" else xp.max(guarded)
-            return {"value": agg, "n": xp.sum(ok)}
+            guarded = xp.where(ok, lengths, xp.asarray(np.float32(identity)))
+            agg = (xp.min(guarded) if tag == "min" else xp.max(guarded)).astype(
+                xp.float64
+            )
+            return {"value": agg, "n": dfops.masked_count(ok, xp)}
 
         return ScanOp(
             tuple(sorted(cols)), update, {"value": tag, "n": "sum"},
@@ -427,7 +440,10 @@ class Mean(StandardScanAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             ok = rows & v.mask
-            return {"sum": xp.sum(xp.where(ok, v.data, 0.0)), "count": xp.sum(ok)}
+            return {
+                "sum": dfops.masked_sum(v.data, v.lo, ok, xp),
+                "count": dfops.masked_count(ok, xp),
+            }
 
         return ScanOp(
             tuple(sorted(cols)), update, {"sum": "sum", "count": "sum"},
@@ -459,7 +475,10 @@ class Sum(StandardScanAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             ok = rows & v.mask
-            return {"sum": xp.sum(xp.where(ok, v.data, 0.0)), "n": xp.sum(ok)}
+            return {
+                "sum": dfops.masked_sum(v.data, v.lo, ok, xp),
+                "n": dfops.masked_count(ok, xp),
+            }
 
         return ScanOp(
             tuple(sorted(cols)), update, {"sum": "sum", "n": "sum"},
@@ -473,15 +492,12 @@ class Sum(StandardScanAnalyzer):
 
 
 def _chunk_moments(vals, row_valid, xp, n, pred, col):
-    """Per-chunk (n, local mean, centered m2) — exact within a chunk."""
+    """Per-chunk (n, local mean, centered m2) — exact within a chunk
+    (two-float compute, ops/df32.py:masked_moments)."""
     rows = _rows(vals, row_valid, xp, n, pred)
     v = vals[col]
     ok = rows & v.mask
-    cnt = xp.sum(ok)
-    s = xp.sum(xp.where(ok, v.data, 0.0))
-    mean = s / xp.maximum(cnt, 1)
-    d = xp.where(ok, v.data - mean, 0.0)
-    m2 = xp.sum(d * d)
+    cnt, s, mean, m2 = dfops.masked_moments(v.data, v.lo, ok, xp)
     return ok, cnt, mean, m2
 
 
@@ -562,21 +578,16 @@ class Correlation(StandardScanAnalyzer):
             rows = _rows(vals, row_valid, xp, n, pred)
             va, vb = vals[ca], vals[cb]
             ok = rows & va.mask & vb.mask
-            cnt = xp.sum(ok)
-            denom = xp.maximum(cnt, 1)
-            xa = xp.where(ok, va.data, 0.0)
-            xb = xp.where(ok, vb.data, 0.0)
-            ma = xp.sum(xa) / denom
-            mb = xp.sum(xb) / denom
-            da = xp.where(ok, va.data - ma, 0.0)
-            db = xp.where(ok, vb.data - mb, 0.0)
+            cnt, ma, mb, ck, x_mk, y_mk = dfops.masked_comoments(
+                va.data, va.lo, vb.data, vb.lo, ok, xp
+            )
             return {
                 "n": cnt,
                 "x_avg": ma,
                 "y_avg": mb,
-                "ck": xp.sum(da * db),
-                "x_mk": xp.sum(da * da),
-                "y_mk": xp.sum(db * db),
+                "ck": ck,
+                "x_mk": x_mk,
+                "y_mk": y_mk,
             }
 
         tags = {k: "gather" for k in ("n", "x_avg", "y_avg", "ck", "x_mk", "y_mk")}
@@ -675,7 +686,7 @@ class DataType(ScanShareableAnalyzer):
                 }[dtype]
                 classes = xp.where(v.mask, const, 0)
             counts = xp.stack(
-                [xp.sum(rows & (classes == k)) for k in range(5)]
+                [dfops.masked_count(rows & (classes == k), xp) for k in range(5)]
             )
             return {"counts": counts}
 

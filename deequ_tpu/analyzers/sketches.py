@@ -116,6 +116,12 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
                     v.data.astype(xp.uint64) ^ xp.uint64(42), xp
                 )
                 valid = rows & v.mask
+            elif v.lo is not None:
+                # two-float pair column: the pair IS the hash key the f64
+                # path derives (hll.py:_f64_key_u64), so hashing it directly
+                # is bit-identical and skips the f64 split on device
+                hashes = hll_ops.hash_pair_device(v.data, v.lo, xp)
+                valid = rows & v.mask
             else:
                 hashes = hll_ops.hash_numeric_device(v.data, xp)
                 valid = rows & v.mask
@@ -355,7 +361,7 @@ def _kll_scan_op(
         rows = _rows(vals, row_valid, xp, n, pred)
         v = vals[col]
         valid = rows & v.mask
-        return chunk_summary(v.data, valid, sketch_size, n, xp)
+        return chunk_summary(v.data, valid, sketch_size, n, xp, lo=v.lo)
 
     tags = {
         "items": "gather",
@@ -385,7 +391,22 @@ def _kll_multi_scan_op(columns: Tuple[str, ...], sketch_size: int) -> ScanOp:
     def update(vals, row_valid, xp, n):
         X = xp.stack([vals[c].data for c in columns])
         M = xp.stack([vals[c].mask & row_valid for c in columns])
-        return chunk_summary_batched(X, M, sketch_size, n, xp)
+        if all(vals[c].lo is not None for c in columns):
+            L = xp.stack([vals[c].lo for c in columns])
+        else:
+            # mixed pair/wide batches aren't coalesced in practice (the
+            # planner groups by dtype-uniform tables), but stay correct
+            X = xp.stack(
+                [
+                    vals[c].data
+                    if vals[c].lo is None
+                    else vals[c].data.astype(xp.float64)
+                    + vals[c].lo.astype(xp.float64)
+                    for c in columns
+                ]
+            )
+            L = None
+        return chunk_summary_batched(X, M, sketch_size, n, xp, lo=L)
 
     tags = {
         "items": "gather",

@@ -16,8 +16,13 @@ import numpy as np
 
 from deequ_tpu.data.table import Column, ColumnarTable, DType, _string_column
 
-_TRUE = {"true", "True", "TRUE"}
-_FALSE = {"false", "False", "FALSE"}
+# pyarrow CSV's default bool literal sets ('1'/'0' included). Pure
+# numeric 0/1 columns never reach the bool check — the integer cast
+# claims them first — so these only matter for columns MIXING word and
+# digit literals, which pyarrow (and the streaming CSV source) infer as
+# bool; keeping the same set preserves read_csv == stream_csv parity.
+_TRUE = {"true", "True", "TRUE", "1"}
+_FALSE = {"false", "False", "FALSE", "0"}
 
 
 def _infer_cell(cell: str):

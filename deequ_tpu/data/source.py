@@ -127,9 +127,14 @@ class ParquetBatchSource(BatchSource):
             other = pf.schema_arrow
             for name in names:
                 idx = other.get_field_index(name)
-                if idx < 0 or not other.field(idx).type.equals(
-                    arrow_schema.field(name).type
-                ):
+                # compare the MAPPED engine dtype, not exact arrow types:
+                # width-compatible files (int32 vs int64, float32 vs
+                # float64) decode to the same Column dtype per batch and
+                # stream fine; only genuine kind conflicts (int vs string)
+                # should fail fast
+                if idx < 0 or _arrow_field_dtype(
+                    other.field(idx).type
+                ) != _arrow_field_dtype(arrow_schema.field(name).type):
                     raise ValueError(
                         f"parquet schema mismatch: column {name!r} in "
                         f"{path!r} is "
@@ -169,31 +174,36 @@ class ParquetBatchSource(BatchSource):
                 yield from_arrow(pa.Table.from_batches([record_batch]))
 
 
-# bool literals per pyarrow CSV inference, minus "0"/"1" which the int
-# cast already claims (matching open_csv, where int64 is tried first)
-_BOOL_LITERALS = frozenset({"true", "false"})
+def _bool_literals() -> frozenset:
+    """Lowered bool literal set, derived from read_csv's _TRUE/_FALSE so
+    the two CSV frontends cannot drift apart."""
+    from deequ_tpu.data.io import _FALSE, _TRUE
+
+    return frozenset(s.lower() for s in (_TRUE | _FALSE))
 
 
 def _classify_string_values(col):
-    """Classify a non-null string array -> (widen rank, is_bool), with
-    the same lattice as pyarrow CSV inference: int64(0) < float64(1) <
-    string(2); bool is rank 0 tracked separately."""
+    """Capability flags (can_int, can_float, can_bool) for one block's
+    non-null string values. Capabilities AND across blocks and the final
+    type applies read_csv's precedence (int > float > bool > string), so
+    block-local classification can never disagree with a whole-file pass
+    — e.g. '0'/'1' rows in one block and 'true' in another still join to
+    BOOLEAN, exactly as read_csv infers over the full column."""
     import pyarrow as pa
     import pyarrow.compute as pc
 
-    try:
-        pc.cast(col, pa.int64())
-        return 0, False
-    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-        pass
+    def can_cast(t):
+        try:
+            pc.cast(col, t)
+            return True
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            return False
+
+    can_int = can_cast(pa.int64())
+    can_float = can_int or can_cast(pa.float64())
     lowered = set(pc.utf8_lower(col).unique().to_pylist())
-    if lowered <= _BOOL_LITERALS:
-        return 0, True
-    try:
-        pc.cast(col, pa.float64())
-        return 1, False
-    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
-        return 2, False
+    can_bool = lowered <= _bool_literals()
+    return can_int, can_float, can_bool
 
 
 class CSVBatchSource(BatchSource):
@@ -265,39 +275,38 @@ class CSVBatchSource(BatchSource):
         all_string = pa.schema(
             [pa.field(n, pa.string()) for n in header.names]
         )
-        rank = {}  # name -> widen rank; bool tracked separately
-        is_bool = {}
+        caps = {}  # name -> (can_int, can_float, can_bool), AND across blocks
         for record_batch in self._open(
             block_rows=1 << 16, pin_schema=all_string
         ):
             for i, field in enumerate(record_batch.schema):
                 name = field.name
-                if rank.get(name) == 2:
+                if caps.get(name) == (False, False, False):
                     continue  # already string; cannot widen further
                 col = record_batch.column(i).drop_null()
                 if len(col) == 0:
                     continue  # all-null block: no information
-                r, b = _classify_string_values(col)
-                prev = rank.get(name)
-                if prev is None:
-                    rank[name] = r
-                    is_bool[name] = b
-                else:
-                    if b != is_bool[name]:
-                        # bool mixed with anything else -> string
-                        rank[name] = 2
-                        is_bool[name] = False
-                    else:
-                        rank[name] = max(prev, r)
+                c = _classify_string_values(col)
+                prev = caps.get(name)
+                caps[name] = c if prev is None else tuple(
+                    a and b for a, b in zip(prev, c)
+                )
         out = []
         for name in header.names:
-            r = rank.get(name)
-            if r is None:
+            c = caps.get(name)
+            if c is None:
                 t = pa.string()  # all-null column
-            elif is_bool.get(name):
-                t = pa.bool_()
             else:
-                t = (pa.int64(), pa.float64(), pa.string())[r]
+                can_int, can_float, can_bool = c
+                # read_csv precedence: int > float > bool > string
+                if can_int:
+                    t = pa.int64()
+                elif can_float:
+                    t = pa.float64()
+                elif can_bool:
+                    t = pa.bool_()
+                else:
+                    t = pa.string()
             out.append(pa.field(name, t))
         return pa.schema(out)
 

@@ -59,6 +59,10 @@ class Val:
     # ARGUMENTS, not trace-time constants — see ops/lut_cache.py); ops
     # declare the tables they need via ScanOp.luts
     luts: Optional[Dict[str, Any]] = None
+    # two-float compute path (ops/df32.py): numeric columns arrive as an
+    # (hi, lo) f32 pair — data is the hi plane, lo the residual plane.
+    # None means data is plain f64 (wide columns, host evaluation).
+    lo: Any = None
 
     def lut(self, kind: str):
         if self.luts is None or kind not in self.luts:
@@ -95,12 +99,27 @@ class EvalContext:
 
     def __init__(self, xp, columns: Dict[str, Val]):
         self.xp = xp
-        self.columns = columns
+        # own (shallow) copy: get() memoizes f64 reconstructions of pair
+        # columns here, and the caller's dict (shared with analyzer
+        # updates, which want the f32 pair) must not see them
+        self.columns = dict(columns)
 
     def get(self, name: str) -> Val:
         if name not in self.columns:
             raise ExprEvalError(f"unknown column: {name}")
-        return self.columns[name]
+        v = self.columns[name]
+        if v.kind == "num" and v.lo is not None:
+            # two-float pair column: the evaluator computes in exact f64
+            # semantics (predicates must match the reference's Spark SQL
+            # doubles bit-for-bit at comparison boundaries), so reconstruct
+            # hi + lo once per chunk and memoize on the context
+            v = Val(
+                "num",
+                v.data.astype(self.xp.float64) + v.lo.astype(self.xp.float64),
+                v.mask,
+            )
+            self.columns[name] = v
+        return v
 
 
 def _str_lut_bool(

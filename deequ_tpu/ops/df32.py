@@ -1,0 +1,247 @@
+"""Two-float (double-float) f32 numerics for the fused scan.
+
+TPU v5e has no native f64 units: XLA emulates every f64 op in software at
+roughly 1/10th of native f32 throughput (measured on this hardware: the
+f64 fused profile scan spends ~30ms of device compute where the f32
+equivalent spends ~2ms). The reference runs on JVM doubles
+(analyzers/StandardDeviation.scala:37-44 and friends assume f64 states), so
+the metric VALUES must keep ~f64 accuracy — the classic resolution is
+double-float arithmetic: represent each f64 value x as a pair of f32s
+
+    hi = f32(x),   lo = f32(x - hi)
+
+which carries ~48 mantissa bits losslessly for the transfer (same 8
+bytes/row as f64), lets every O(n) device operation run on native f32/i32
+vector units, and confines f64 to O(1) scalars and O(n/2^levels) reduction
+tails. Error-free transformations (Knuth TwoSum, Dekker TwoProd) keep the
+accumulated reductions accurate to ~1e-13 relative — validated against the
+f64 goldens (tests/test_analyzers_golden.py asserts rel=1e-12).
+
+The same pair (bitcast to u32s) is ALSO the HLL hash key the engine already
+used (ops/hll.py:_f64_key_u64 splits f64 exactly this way because the
+tunnel compiler rejects 64-bit bitcasts) — so sketches stay bit-identical.
+
+Every helper takes ``lo=None`` to mean "data is plain f64" (the escape
+hatch for |x| > f32_max columns and DEEQU_TPU_COMPUTE=f64) and falls back
+to the straight f64 reduction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+F32_MAX = float(np.finfo(np.float32).max)
+
+# pair-path magnitude ceiling: 2^59 (~5.8e17). The pair REPRESENTATION
+# is fine up to f32_max (~2^128), but the f32 arithmetic downstream needs
+# headroom for the WORST compound: centered squares (values up to 2*max,
+# squares 4*max^2) accumulated through 2^TREE_LEVELS = 32 tree halvings
+# before the f64 tail — requiring 128 * max^2 < f32_max, i.e.
+# max < 2^60.5 — plus the Dekker-split scratch (x * 4097). 2^59 clears
+# the square-tree bound with 8x margin and the plain sum bound
+# (2^25 rows * max) by far; larger columns route to the wide-f64 path
+# (scan_engine._packs_as_pair).
+PAIR_SAFE_MAX = float(2 ** 59)
+
+# number of pairwise halving levels before the f64 tail reduce: the tail
+# touches n/2^LEVELS elements in f64, which is negligible at 5 levels
+TREE_LEVELS = 5
+
+
+def split_pair_np(x: np.ndarray):
+    """Host-side packer split: f64 -> (hi, lo) f32 planes.
+
+    Mirrors ops/hll.py:_f64_key_u64 exactly (canonical +0.0 fold first) so
+    device HLL hashing over the shipped pair is bit-identical to hashing
+    the f64 values. Non-finite residuals (x = +/-inf => x - hi = nan)
+    are zeroed so sums over columns containing infinities still produce
+    the IEEE result (inf/nan) through the hi plane alone.
+    """
+    canonical = x + 0.0
+    with np.errstate(over="ignore", invalid="ignore"):
+        hi = canonical.astype(np.float32)
+        diff = canonical - hi.astype(np.float64)
+        lo = np.where(np.isfinite(diff), diff, 0.0).astype(np.float32)
+    return hi, lo
+
+
+def pair_safe_np(values: np.ndarray) -> bool:
+    """True when every finite value is safe for the f32-pair COMPUTE path
+    (|x| <= PAIR_SAFE_MAX, leaving headroom for squares and partial-sum
+    growth); columns with larger magnitudes ship as wide f64."""
+    if len(values) == 0:
+        return True
+    with np.errstate(invalid="ignore"):
+        finite = values[np.isfinite(values)]
+    if len(finite) == 0:
+        return True
+    m = float(np.max(np.abs(finite)))
+    return m <= PAIR_SAFE_MAX
+
+
+def two_sum(a, b):
+    """Error-free sum: s + err == a + b exactly (Knuth)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _two_prod_err(a, b, p, xp):
+    """Error term of p = a*b (Dekker split; no FMA exposed through jnp)."""
+    split = xp.asarray(np.float32(4097.0))  # 2^12 + 1
+    ta = a * split
+    ah = ta - (ta - a)
+    al = a - ah
+    tb = b * split
+    bh = tb - (tb - b)
+    bl = b - bh
+    return ((ah * bh - p) + ah * bl + al * bh) + al * bl
+
+
+def int32_pair(v, xp):
+    """Exact normalized (hi, lo) f32 pair from an int32 array.
+
+    Split at 15 bits so both halves convert to f32 exactly, then one
+    TwoSum renormalizes to (f32(v), v - f32(v)) — the same pair the packer
+    produces for f64 values, keeping HLL keys consistent.
+    """
+    low = v & xp.int32(0x7FFF)
+    high = v - low
+    hi0 = high.astype(xp.float32)
+    lo0 = low.astype(xp.float32)
+    return two_sum(hi0, lo0)
+
+
+def _f32 (xp, x):
+    return xp.asarray(np.float32(x))
+
+
+def _pair_tree_sum(s, c, xp, levels: int = TREE_LEVELS):
+    """Reduce (s, c) f32 arrays to one f64 scalar: `levels` halving rounds
+    of TwoSum with exact error accumulation, then an f64 tail reduce over
+    the n/2^levels survivors."""
+    for _ in range(levels):
+        m = s.shape[0]
+        if m <= 1:
+            break
+        if m % 2:
+            pad = xp.zeros((1,), dtype=s.dtype)
+            s = xp.concatenate([s, pad])
+            c = xp.concatenate([c, pad])
+            m += 1
+        half = m // 2
+        s, err = two_sum(s[:half], s[half:])
+        c = c[:half] + c[half:] + err
+    return xp.sum(s.astype(xp.float64)) + xp.sum(c.astype(xp.float64))
+
+
+def masked_sum(hi, lo, ok, xp):
+    """Sum of the pair values where ok — f64 scalar, ~1e-13 accurate."""
+    if lo is None:
+        return xp.sum(xp.where(ok, hi, 0.0))
+    z = _f32(xp, 0.0)
+    s = xp.where(ok, hi, z)
+    c = xp.where(ok, lo, z)
+    return _pair_tree_sum(s, c, xp)
+
+
+def masked_count(ok, xp):
+    """Row count as i32 (chunks are < 2^31 rows by construction)."""
+    return xp.sum(ok, dtype=xp.int32)
+
+
+def masked_extremum(hi, lo, ok, xp, mode: str):
+    """Exact min/max of pair values where ok, as an f64 scalar.
+
+    Two-stage: extremum over hi, then over lo among the hi-ties. Exact
+    because hi is the rounded-to-nearest f32 of x: hi_a < hi_b implies
+    x_a <= x_b, so the true extremum lives in the hi-tie group.
+    """
+    red = xp.min if mode == "min" else xp.max
+    if lo is None:
+        ident = np.inf if mode == "min" else -np.inf
+        return red(xp.where(ok, hi, ident))
+    ident = _f32(xp, np.inf if mode == "min" else -np.inf)
+    gh = xp.where(ok, hi, ident)
+    eh = red(gh)
+    gl = xp.where(ok & (gh == eh), lo, ident)
+    el = red(gl)
+    # all-masked chunks: eh = +/-inf and el = +/-inf; callers guard on the
+    # separate count, and inf + inf keeps the sign
+    return eh.astype(xp.float64) + el.astype(xp.float64)
+
+
+def _center(hi, lo, mean64, ok, xp):
+    """(x - mean) as a renormalized f32 pair, masked rows zeroed.
+    mean64 is an f64 SCALAR (scalar f64 ops are free on TPU)."""
+    mh = mean64.astype(xp.float32)
+    ml = (mean64 - mh.astype(xp.float64)).astype(xp.float32)
+    if lo is None:
+        # wide-f64 column: center in f64 directly
+        d = xp.where(ok, hi - mean64, 0.0)
+        return d, None
+    z = _f32(xp, 0.0)
+    dh0 = hi - mh
+    dl0 = lo - ml
+    dh, err = two_sum(dh0, dl0)
+    dh = xp.where(ok, dh, z)
+    dl = xp.where(ok, err, z)
+    return dh, dl
+
+
+def _sqr_pair(dh, dl, xp):
+    """d^2 as (p, e) with p = f32 square and e the exact correction
+    (TwoProd error + cross term; dl^2 is below the accumulation noise)."""
+    p = dh * dh
+    e = _two_prod_err(dh, dh, p, xp) + (dh + dh) * dl
+    return p, e
+
+
+def _mul_pair(ah, al, bh, bl, xp):
+    """a*b as (p, e) for two pairs (co-moment products)."""
+    p = ah * bh
+    e = _two_prod_err(ah, bh, p, xp) + ah * bl + al * bh
+    return p, e
+
+
+def masked_moments(hi, lo, ok, xp):
+    """(count_i32, sum_f64, mean_f64, m2_f64) — the Welford chunk moments
+    (reference StandardDeviation.scala:37-44 merges these across chunks)."""
+    cnt = masked_count(ok, xp)
+    s = masked_sum(hi, lo, ok, xp)
+    mean = s / xp.maximum(cnt, 1)
+    dh, dl = _center(hi, lo, mean, ok, xp)
+    if dl is None:
+        m2 = xp.sum(dh * dh)
+    else:
+        p, e = _sqr_pair(dh, dl, xp)
+        m2 = _pair_tree_sum(p, e, xp)
+    return cnt, s, mean, m2
+
+
+def masked_comoments(a_hi, a_lo, b_hi, b_lo, ok, xp):
+    """Correlation co-moment chunk state (n, x_avg, y_avg, ck, x_mk, y_mk)
+    (reference Correlation.scala:37-52)."""
+    cnt = masked_count(ok, xp)
+    denom = xp.maximum(cnt, 1)
+    sa = masked_sum(a_hi, a_lo, ok, xp)
+    sb = masked_sum(b_hi, b_lo, ok, xp)
+    ma = sa / denom
+    mb = sb / denom
+    dah, dal = _center(a_hi, a_lo, ma, ok, xp)
+    dbh, dbl = _center(b_hi, b_lo, mb, ok, xp)
+    if dal is None or dbl is None:
+        da64 = dah if dal is None else dah.astype(xp.float64) + dal.astype(xp.float64)
+        db64 = dbh if dbl is None else dbh.astype(xp.float64) + dbl.astype(xp.float64)
+        ck = xp.sum(da64 * db64)
+        x_mk = xp.sum(da64 * da64)
+        y_mk = xp.sum(db64 * db64)
+    else:
+        pc, ec = _mul_pair(dah, dal, dbh, dbl, xp)
+        ck = _pair_tree_sum(pc, ec, xp)
+        pa, ea = _sqr_pair(dah, dal, xp)
+        x_mk = _pair_tree_sum(pa, ea, xp)
+        pb, eb = _sqr_pair(dbh, dbl, xp)
+        y_mk = _pair_tree_sum(pb, eb, xp)
+    return cnt, ma, mb, ck, x_mk, y_mk

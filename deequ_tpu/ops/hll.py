@@ -163,6 +163,27 @@ def hash_numeric_device(values, xp, seed: int = XXHASH_SEED):
     return splitmix64(bits ^ xp.uint64((seed * 0x9E3779B97F4A7C15) & _MASK64), xp)
 
 
+def hash_pair_device(hi, lo, xp, seed: int = XXHASH_SEED):
+    """Hash two-float pair columns (ops/df32.py) on device.
+
+    The packer's (hi, lo) planes are exactly the double-float split
+    _f64_key_u64 derives from f64 values (same canonical +0.0 fold, same
+    rounding), so bitcasting them directly yields a BIT-IDENTICAL key —
+    pair-path HLL states merge with f64-path and host-built ones.
+    """
+    import jax
+
+    # the packer already canonicalizes -0.0 and pair columns exclude
+    # |x| > f32_max, so the only divergence from _f64_key_u64 is at
+    # x = +/-inf/NaN, where that path's residual is NaN but the packer
+    # zeroes it (so sums stay IEEE-correct); restore NaN for the key
+    lo = xp.where(xp.isfinite(hi), lo, xp.asarray(np.float32(np.nan)))
+    hi_bits = jax.lax.bitcast_convert_type(hi, xp.uint32).astype(xp.uint64)
+    lo_bits = jax.lax.bitcast_convert_type(lo, xp.uint32).astype(xp.uint64)
+    bits = (hi_bits << xp.uint64(32)) | lo_bits
+    return splitmix64(bits ^ xp.uint64((seed * 0x9E3779B97F4A7C15) & _MASK64), xp)
+
+
 def clz64(x, xp):
     """Branchless count-leading-zeros for uint64 arrays."""
     n = xp.full(xp.shape(x), 64, dtype=xp.int32)

@@ -42,17 +42,45 @@ def strata_capacity(local_n: int, sketch_size: int) -> int:
     return 1 << max(math.ceil(math.log2(ratio)), 0)
 
 
-def chunk_summary(x, valid, sketch_size: int, local_n: int, xp):
+def chunk_summary(x, valid, sketch_size: int, local_n: int, xp, lo=None):
     """Inside-jit: one chunk/shard -> fixed-shape weighted summary.
 
     Returns {items (k+W,), weights (k+W,), count, min, max}; padding slots
     carry weight 0. Static shapes: k = sketch_size, W = strata_capacity.
+
+    Two-float pair columns (``lo`` given, ops/df32.py): the sort runs on
+    the f32 hi plane natively (f64 sorts are software-emulated on TPU) via
+    argsort, the lo plane rides along through the same permutation, and
+    f64 items are reconstructed only at the k+W gather points. Ties in hi
+    order arbitrarily — the tied values differ by < 1 ulp(f32) relatively,
+    far below the sketch's own rank error of w/2.
     """
     k = sketch_size
     W = strata_capacity(local_n, k)
 
-    xf = xp.where(valid, x.astype(xp.float64), xp.inf)
-    sx = xp.sort(xf)
+    if lo is not None:
+        from deequ_tpu.ops.df32 import masked_extremum
+
+        xf32 = xp.where(valid, x, xp.asarray(np.float32(np.inf)))
+        order = xp.argsort(xf32)
+        sx_hi = xf32[order]
+        sx_lo = xp.where(valid, lo, xp.asarray(np.float32(0.0)))[order]
+
+        def gather_items(idx):
+            return sx_hi[idx].astype(xp.float64) + sx_lo[idx].astype(xp.float64)
+
+        mn = masked_extremum(x, lo, valid, xp, "min")
+        mx = masked_extremum(x, lo, valid, xp, "max")
+    else:
+        xf = xp.where(valid, x.astype(xp.float64), xp.inf)
+        sx = xp.sort(xf)
+
+        def gather_items(idx):
+            return sx[idx]
+
+        mn = xp.min(xp.where(valid, x.astype(xp.float64), xp.inf))
+        mx = xp.max(xp.where(valid, x.astype(xp.float64), -xp.inf))
+
     m = valid.sum()
 
     # weight w = 2^L with L = ceil(log2(ceil(m/k))): the smallest power of
@@ -65,13 +93,13 @@ def chunk_summary(x, valid, sketch_size: int, local_n: int, xp):
     # strata midpoints: item i represents rows [i*w, (i+1)*w)
     sidx = xp.arange(k) * w + w // 2
     s_on = xp.arange(k) < n_strata
-    items_s = sx[xp.clip(sidx, 0, local_n - 1)]
+    items_s = gather_items(xp.clip(sidx, 0, local_n - 1))
     weights_s = xp.where(s_on, w, 0)
 
     # exact remainder (< w items) at level 0, preserving total weight == m
     ridx = n_strata * w + xp.arange(W)
     r_on = ridx < m
-    items_r = sx[xp.clip(ridx, 0, local_n - 1)]
+    items_r = gather_items(xp.clip(ridx, 0, local_n - 1))
     weights_r = xp.where(r_on, 1, 0)
 
     items = xp.concatenate([items_s, items_r])
@@ -79,8 +107,6 @@ def chunk_summary(x, valid, sketch_size: int, local_n: int, xp):
     # zero the padding values so gathered buffers are deterministic
     items = xp.where(weights > 0, items, 0.0)
 
-    mn = xp.min(xp.where(valid, xf, xp.inf))
-    mx = xp.max(xp.where(valid, x.astype(xp.float64), -xp.inf))
     return {
         "items": items,
         "weights": weights.astype(xp.float64),
@@ -90,7 +116,7 @@ def chunk_summary(x, valid, sketch_size: int, local_n: int, xp):
     }
 
 
-def chunk_summary_batched(X, M, sketch_size: int, local_n: int, xp):
+def chunk_summary_batched(X, M, sketch_size: int, local_n: int, xp, lo=None):
     """K columns at once: (K, n) values + (K, n) validity -> summaries with
     a leading K axis. One BATCHED device sort (vmap) instead of K
     independent sorts — XLA tiles the (K, n) sort far better than K
@@ -98,6 +124,12 @@ def chunk_summary_batched(X, M, sketch_size: int, local_n: int, xp):
     profiles (BASELINE config 3: ApproxQuantile over 50 columns)."""
     import jax
 
+    if lo is not None:
+        return jax.vmap(
+            lambda x, v, l: chunk_summary(
+                x, v, sketch_size, local_n, xp, lo=l
+            )
+        )(X, M, lo)
     return jax.vmap(
         lambda x, v: chunk_summary(x, v, sketch_size, local_n, xp)
     )(X, M)

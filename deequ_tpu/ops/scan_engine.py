@@ -61,7 +61,12 @@ def _auto_chunk_rows_from_dtypes(
 ) -> int:
     bytes_per_row = 0
     for dtype in dtypes:
-        bytes_per_row += 4 if dtype == DType.STRING else 9  # f64 + mask
+        if dtype == DType.STRING:
+            bytes_per_row += 4  # i32 codes
+        elif dtype == DType.FRACTIONAL:
+            bytes_per_row += 9  # f32 pair + mask
+        else:
+            bytes_per_row += 5  # i32 + mask
     bytes_per_row = max(bytes_per_row, 1)
     rows = target_bytes // bytes_per_row
     return int(min(max(rows, 1 << 18), max_rows))
@@ -196,9 +201,12 @@ def _tag_identity_wrap(tag: str, leaf):
 
 def _packs_as_i32(col: Column) -> bool:
     """Integral columns whose values fit int32 transfer at half width,
-    losslessly (upcast to f64 happens inside the jitted step). The O(n)
-    min/max is computed once per Column and cached (repeated packer
+    losslessly (the exact (hi, lo) f32 split happens inside the jitted
+    step, ops/df32.py:int32_pair). Boolean columns always qualify. The
+    O(n) min/max is computed once per Column and cached (repeated packer
     construction over streaming batches / persisted tables reuses it)."""
+    if col.dtype == DType.BOOLEAN:
+        return True
     if col.dtype != DType.INTEGRAL or len(col.values) == 0:
         return False
     cached = getattr(col, "_i32_safe", None)
@@ -210,26 +218,58 @@ def _packs_as_i32(col: Column) -> bool:
     return cached
 
 
+def _packs_as_pair(col: Column) -> bool:
+    """Fractional columns whose finite values fit the (hi, lo) f32 pair
+    representation (|x| <= f32_max) — the native-dtype compute path. The
+    range check is cached per Column like _packs_as_i32."""
+    from deequ_tpu.ops.df32 import pair_safe_np
+
+    if col.dtype != DType.FRACTIONAL:
+        return False
+    cached = getattr(col, "_pair_safe", None)
+    if cached is None:
+        cached = pair_safe_np(col.values)
+        col._pair_safe = cached
+    return cached
+
+
 def _transfer_f32() -> bool:
-    """Opt-in lossy mode: fractional columns transfer as f32 (half the
-    bytes) and upcast on device. Metric values then reflect f32-rounded
-    inputs — acceptable for profiling/monitoring, off by default."""
+    """Opt-in lossy mode: fractional columns transfer ONLY the hi plane
+    (half the bytes) and compute with lo = 0. Metric values then reflect
+    f32-rounded inputs — acceptable for profiling/monitoring, off by
+    default."""
     import os
 
     return os.environ.get("DEEQU_TPU_TRANSFER_F32", "0") == "1"
 
 
+def _compute_f64() -> bool:
+    """Opt-out of the two-float compute path: fractional columns ship and
+    compute as f64 (the pre-round-4 behavior; ~10x slower device compute
+    on TPU, bit-identical to host f64 math)."""
+    import os
+
+    return os.environ.get("DEEQU_TPU_COMPUTE", "").lower() == "f64"
+
+
 class _ChunkPacker:
     """Packs one chunk of a table into a handful of contiguous host buffers
-    (f64 values, narrow i32/f32 values, validity masks, string codes).
+    (two-float f32 pair planes, wide f64 values, narrow i32 values,
+    validity masks, string codes).
 
     Host->device transfer over the TPU tunnel has ~0.2s per-call latency AND
     ~33MB/s bandwidth for novel bytes, so the packer both batches transfers
     (one buffer per dtype class instead of 2 x N columns) and minimizes
-    bytes: int32-safe integral columns ship at half width (lossless),
-    null-free columns ship no mask row, and DEEQU_TPU_TRANSFER_F32=1 ships
-    fractional columns as f32 (lossy, opt-in). Column slicing and upcasting
-    happen inside the jitted program where they're free.
+    bytes. Column routing (the native-dtype compute path, ops/df32.py):
+
+    - fractional -> (hi, lo) f32 pair planes: same 8 bytes/row as f64,
+      ~48-bit lossless, every O(n) device op runs on native f32 units;
+    - int32-safe integral + boolean -> i32 plane (exact pair split happens
+      on device);
+    - huge integers, |x| > f32_max fractionals, and DEEQU_TPU_COMPUTE=f64
+      -> wide f64 plane (XLA software-f64 fallback);
+    - DEEQU_TPU_TRANSFER_F32=1 -> hi plane only (lossy, opt-in);
+    - null-free columns ship no mask row (validity is just row_valid).
     """
 
     def __init__(
@@ -245,25 +285,40 @@ class _ChunkPacker:
             # stream so the traced program is reusable (the caller validates
             # each batch against it, see _layout_upgrades)
             self.narrow_i32 = list(layout["narrow_i32"])
-            self.narrow_f32 = list(layout["narrow_f32"])
+            self.pair_names = list(layout["pair"])
+            self.hi_only_names = list(layout["hi_only"])
             self.wide_names = list(layout["wide"])
             self.masked_names = list(layout["masked"])
         else:
             f32_mode = _transfer_f32()
+            f64_mode = _compute_f64()
             self.narrow_i32 = [n for n in numeric if _packs_as_i32(cols[n])]
-            self.narrow_f32 = (
-                [n for n in numeric if f32_mode and cols[n].dtype == DType.FRACTIONAL]
-                if f32_mode
-                else []
+            self.pair_names = []
+            self.hi_only_names = []
+            if not f64_mode:
+                for n in numeric:
+                    if cols[n].dtype != DType.FRACTIONAL:
+                        continue
+                    if f32_mode:
+                        self.hi_only_names.append(n)
+                    elif _packs_as_pair(cols[n]):
+                        self.pair_names.append(n)
+            routed = (
+                set(self.narrow_i32)
+                | set(self.pair_names)
+                | set(self.hi_only_names)
             )
-            narrow = set(self.narrow_i32) | set(self.narrow_f32)
-            self.wide_names = [n for n in numeric if n not in narrow]
+            self.wide_names = [n for n in numeric if n not in routed]
             # null-free columns don't ship a mask row at all — their
             # validity is just row_valid (saves 1 byte/row/column)
             self.masked_names = [
                 n for n in numeric if not bool(cols[n].mask.all())
             ]
         self.numeric_names = numeric
+        # the hi buffer carries pair columns first, then hi-only columns
+        self._hi_row = {
+            n: i for i, n in enumerate(self.pair_names + self.hi_only_names)
+        }
         self._mask_row = {n: i for i, n in enumerate(self.masked_names)}
         self.cols = cols
         self.chunk = chunk
@@ -276,6 +331,8 @@ class _ChunkPacker:
         }
 
     def pack(self, start: int, stop: int):
+        from deequ_tpu.ops.df32 import split_pair_np
+
         chunk = self.chunk
         n = stop - start
 
@@ -290,50 +347,87 @@ class _ChunkPacker:
             return out
 
         values = buf(self.wide_names, np.float64, 0.0)
+        hi = buf(self.pair_names + self.hi_only_names, np.float32, 0.0)
+        lo = buf(self.pair_names, np.float32, 0.0)
         narrow_i = buf(self.narrow_i32, np.int32, 0)
-        narrow_f = buf(self.narrow_f32, np.float32, 0.0)
         masks = buf(self.masked_names, np.bool_, False)
         codes = buf(self.string_names, np.int32, -1)
 
         for i, name in enumerate(self.wide_names):
             values[i, :n] = self.cols[name].values[start:stop]
+        for i, name in enumerate(self.pair_names):
+            h, l = split_pair_np(self.cols[name].values[start:stop])
+            hi[self._hi_row[name], :n] = h
+            lo[i, :n] = l
+        for name in self.hi_only_names:
+            with np.errstate(over="ignore", invalid="ignore"):
+                hi[self._hi_row[name], :n] = self.cols[name].values[
+                    start:stop
+                ].astype(np.float32)
         for i, name in enumerate(self.narrow_i32):
             narrow_i[i, :n] = self.cols[name].values[start:stop]
-        for i, name in enumerate(self.narrow_f32):
-            narrow_f[i, :n] = self.cols[name].values[start:stop]
         for name, i in self._mask_row.items():
             masks[i, :n] = self.cols[name].mask[start:stop]
         for j, name in enumerate(self.string_names):
             codes[j, :n] = self.cols[name].codes[start:stop]
         row_valid = np.zeros(chunk, dtype=np.bool_)
         row_valid[:n] = True
-        return values, narrow_i, narrow_f, masks, codes, row_valid
+        return values, hi, lo, narrow_i, masks, codes, row_valid
 
     def unpack_vals(
-        self, values, narrow_i, narrow_f, masks, codes, xp, row_valid=None,
+        self, values, hi, lo, narrow_i, masks, codes, xp, row_valid=None,
         col_luts=None,
     ) -> Dict[str, Val]:
-        """Slice the packed buffers back into per-column Vals (inside jit)."""
+        """Slice the packed buffers back into per-column Vals (inside jit).
+
+        Numeric Vals carry the two-float pair: ``data`` = f32 hi plane,
+        ``lo`` = f32 lo plane (None for wide-f64 columns). Reductions go
+        through ops/df32.py; the expression evaluator reconstructs f64
+        lazily (expr/eval.py:EvalContext.get)."""
+        from deequ_tpu.ops.df32 import int32_pair
+
         vals: Dict[str, Val] = {}
-        sources = {}
-        for i, name in enumerate(self.wide_names):
-            sources[name] = values[i]
-        for i, name in enumerate(self.narrow_i32):
-            sources[name] = narrow_i[i].astype(xp.float64)
-        for i, name in enumerate(self.narrow_f32):
-            sources[name] = narrow_f[i].astype(xp.float64)
+        pair_set = set(self.pair_names)
+        hi_only_set = set(self.hi_only_names)
+        narrow_set = set(self.narrow_i32)
+        wide_row = {n: i for i, n in enumerate(self.wide_names)}
+        narrow_row = {n: i for i, n in enumerate(self.narrow_i32)}
         for name in self.numeric_names:
-            data = sources[name]
             if name in self._mask_row:
                 mask = masks[self._mask_row[name]]
             elif row_valid is not None:
                 mask = row_valid
             else:
-                mask = xp.ones(data.shape, dtype=bool)
-            if self.col_dtype[name] == DType.BOOLEAN:
-                vals[name] = Val("bool", data != 0.0, mask)
+                mask = None  # shaped below once data is known
+            dtype = self.col_dtype[name]
+            if name in narrow_set:
+                data_i = narrow_i[narrow_row[name]]
+                if mask is None:
+                    mask = xp.ones(data_i.shape, dtype=bool)
+                if dtype == DType.BOOLEAN:
+                    vals[name] = Val("bool", data_i != 0, mask)
+                else:
+                    h, l = int32_pair(data_i, xp)
+                    vals[name] = Val("num", h, mask, lo=l)
+            elif name in pair_set:
+                h = hi[self._hi_row[name]]
+                l = lo[self.pair_names.index(name)]
+                if mask is None:
+                    mask = xp.ones(h.shape, dtype=bool)
+                vals[name] = Val("num", h, mask, lo=l)
+            elif name in hi_only_set:
+                h = hi[self._hi_row[name]]
+                if mask is None:
+                    mask = xp.ones(h.shape, dtype=bool)
+                vals[name] = Val("num", h, mask, lo=xp.zeros_like(h))
             else:
-                vals[name] = Val("num", data, mask)
+                data = values[wide_row[name]]
+                if mask is None:
+                    mask = xp.ones(data.shape, dtype=bool)
+                if dtype == DType.BOOLEAN:
+                    vals[name] = Val("bool", data != 0.0, mask)
+                else:
+                    vals[name] = Val("num", data, mask)
         for j, name in enumerate(self.string_names):
             vals[name] = Val(
                 "str", codes[j], None, dictionary=self.col_dict[name],
@@ -344,7 +438,8 @@ class _ChunkPacker:
     def layout(self) -> dict:
         return {
             "narrow_i32": tuple(self.narrow_i32),
-            "narrow_f32": tuple(self.narrow_f32),
+            "pair": tuple(self.pair_names),
+            "hi_only": tuple(self.hi_only_names),
             "wide": tuple(self.wide_names),
             "masked": tuple(self.masked_names),
         }
@@ -355,10 +450,12 @@ class _ChunkPacker:
         view = _ChunkPacker.__new__(_ChunkPacker)
         view.string_names = self.string_names
         view.narrow_i32 = self.narrow_i32
-        view.narrow_f32 = self.narrow_f32
+        view.pair_names = self.pair_names
+        view.hi_only_names = self.hi_only_names
         view.wide_names = self.wide_names
         view.numeric_names = self.numeric_names
         view.masked_names = self.masked_names
+        view._hi_row = self._hi_row
         view._mask_row = self._mask_row
         view.cols = None  # pack() is not available on a view
         view.chunk = self.chunk
@@ -411,7 +508,7 @@ class DeviceTableCache:
     def __init__(self, packer, chunk, device_chunks, mesh, nbytes, device_count):
         self.packer = packer
         self.chunk = chunk
-        self.device_chunks = device_chunks  # list of 6-tuples of device arrays
+        self.device_chunks = device_chunks  # list of 7-tuples of device arrays (values, hi, lo, narrow_i, masks, codes, row_valid)
         self.mesh = mesh
         self.nbytes = nbytes
         self.device_count = device_count
@@ -491,13 +588,9 @@ def persist_table(
     if mesh is not None:
         from jax.sharding import NamedSharding
 
-        shardings = (
-            NamedSharding(mesh, P(None, ROW_AXIS)),
-            NamedSharding(mesh, P(None, ROW_AXIS)),
-            NamedSharding(mesh, P(None, ROW_AXIS)),
-            NamedSharding(mesh, P(None, ROW_AXIS)),
-            NamedSharding(mesh, P(None, ROW_AXIS)),
-            NamedSharding(mesh, P(ROW_AXIS)),
+        shardings = tuple(
+            [NamedSharding(mesh, P(None, ROW_AXIS))] * 6
+            + [NamedSharding(mesh, P(ROW_AXIS))]
         )
 
         def put(args):
@@ -533,13 +626,9 @@ def _make_put(mesh):
         return jax.device_put
     from jax.sharding import NamedSharding
 
-    arg_shardings = (
-        NamedSharding(mesh, P(None, ROW_AXIS)),
-        NamedSharding(mesh, P(None, ROW_AXIS)),
-        NamedSharding(mesh, P(None, ROW_AXIS)),
-        NamedSharding(mesh, P(None, ROW_AXIS)),
-        NamedSharding(mesh, P(None, ROW_AXIS)),
-        NamedSharding(mesh, P(ROW_AXIS)),
+    arg_shardings = tuple(
+        [NamedSharding(mesh, P(None, ROW_AXIS))] * 6
+        + [NamedSharding(mesh, P(ROW_AXIS))]
     )
 
     def put(args):
@@ -564,13 +653,13 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
     registers i32). ``lut_keys`` names the dictionary LUTs passed as an
     extra dict argument (replicated across the mesh)."""
 
-    def step(values, narrow_i, narrow_f, masks, codes, row_valid, luts):
+    def step(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
         col_luts: Dict[str, Dict[str, Any]] = {}
         for key, arr in luts.items():
             col, kind = _split_lut_key(key)
             col_luts.setdefault(col, {})[kind] = arr
         vals = unpacker.unpack_vals(
-            values, narrow_i, narrow_f, masks, codes, jnp, row_valid,
+            values, hi, lo, narrow_i, masks, codes, jnp, row_valid,
             col_luts=col_luts,
         )
         partials = tuple(op.update(vals, row_valid, jnp, local_n) for op in ops)
@@ -602,7 +691,7 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
             mesh=mesh,
             in_specs=(
                 P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
-                P(None, ROW_AXIS), P(None, ROW_AXIS),
+                P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
                 P(ROW_AXIS),
                 {key: P() for key in lut_keys},
             ),
@@ -610,16 +699,16 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
             check_vma=False,
         )
 
-        def flat_outer(values, narrow_i, narrow_f, masks, codes, row_valid, luts):
+        def flat_outer(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
             return _flatten(
-                inner(values, narrow_i, narrow_f, masks, codes, row_valid, luts)
+                inner(values, hi, lo, narrow_i, masks, codes, row_valid, luts)
             )
 
         return jax.jit(flat_outer), inner
 
-    def flat_single(values, narrow_i, narrow_f, masks, codes, row_valid, luts):
+    def flat_single(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
         return _flatten(
-            step(values, narrow_i, narrow_f, masks, codes, row_valid, luts)
+            step(values, hi, lo, narrow_i, masks, codes, row_valid, luts)
         )
 
     return jax.jit(flat_single), step
@@ -630,7 +719,12 @@ def _unflatten_partials(flat: np.ndarray, shapes):
     offset = 0
     for sd in jax.tree.leaves(shapes):
         size = int(np.prod(sd.shape)) if sd.shape else 1
-        leaf = flat[offset:offset + size].reshape(sd.shape).astype(sd.dtype)
+        # integer leaves (i32 device counts) widen to i64 on host: the
+        # cross-CHUNK accumulation in _tag_reduce_np would otherwise wrap
+        # silently past 2^31 rows on long streams (per-chunk counts fit
+        # i32 by construction; the accumulator must not)
+        dtype = np.int64 if np.issubdtype(sd.dtype, np.integer) else sd.dtype
+        leaf = flat[offset:offset + size].reshape(sd.shape).astype(dtype)
         leaves.append(leaf if sd.shape else leaf.reshape(()))
         offset += size
     return jax.tree.unflatten(jax.tree.structure(shapes), leaves)
@@ -684,7 +778,7 @@ def _mesh_key(mesh):
     )
 
 
-def _global_prog_key(prog_key, packer, dtypes, mesh):
+def _global_prog_key(prog_key, packer, mesh):
     """Key for the cross-table program cache. Only table-INDEPENDENT
     programs are cacheable: string ops that route their dictionary
     dependence through LUT arguments qualify; an op that reads the
@@ -695,10 +789,13 @@ def _global_prog_key(prog_key, packer, dtypes, mesh):
     layout = (
         tuple(packer.wide_names),
         tuple(packer.narrow_i32),
-        tuple(packer.narrow_f32),
+        tuple(packer.pair_names),
+        tuple(packer.hi_only_names),
         tuple(packer.masked_names),
         tuple(packer.string_names),
-        tuple((name, dtypes[name]) for name in packer.numeric_names),
+        # packer.col_dtype, not the caller's needed-column subset: a
+        # persisted table's packer covers ALL table columns
+        tuple((name, packer.col_dtype[name]) for name in packer.numeric_names),
     )
     return (prog_key, layout, _mesh_key(mesh))
 
@@ -796,7 +893,7 @@ def run_scan(
     prog_key = _ops_prog_key(ops, chunk, lut_sig)
     dtypes = {n: c.dtype for n, c in cols.items()}
     global_key = (
-        _global_prog_key(prog_key, packer, dtypes, mesh) if not baked else None
+        _global_prog_key(prog_key, packer, mesh) if not baked else None
     )
     cached_prog = None
     if cache is not None and prog_key is not None:
@@ -934,12 +1031,17 @@ def _prefetch(iterator, depth: int = 2):
 def _layout_upgrades(layout: dict, cols: Dict[str, Column]) -> Optional[dict]:
     """Check one batch against the stream's pinned packer layout; returns
     an upgraded layout if this batch cannot use it (an int column outgrew
-    i32, or a previously null-free column produced nulls), else None.
-    Upgrades are monotone (narrow -> wide, unmasked -> masked), so a stream
+    i32, a fractional column outgrew the f32 pair range, or a previously
+    null-free column produced nulls), else None. Upgrades are monotone
+    (narrow -> wide, pair -> wide, unmasked -> masked), so a stream
     retraces at most a handful of times."""
     promote = [
         n for n in layout["narrow_i32"] if n in cols and not _packs_as_i32(cols[n])
     ]
+    promote += [
+        n for n in layout["pair"] if n in cols and not _packs_as_pair(cols[n])
+    ]
+    promote_set = set(promote)
     masked = set(layout["masked"])
     need_mask = [
         n
@@ -951,8 +1053,11 @@ def _layout_upgrades(layout: dict, cols: Dict[str, Column]) -> Optional[dict]:
     if not promote and not need_mask:
         return None
     return {
-        "narrow_i32": tuple(n for n in layout["narrow_i32"] if n not in promote),
-        "narrow_f32": layout["narrow_f32"],
+        "narrow_i32": tuple(
+            n for n in layout["narrow_i32"] if n not in promote_set
+        ),
+        "pair": tuple(n for n in layout["pair"] if n not in promote_set),
+        "hi_only": layout["hi_only"],
         "wide": tuple(list(layout["wide"]) + promote),
         "masked": tuple(list(layout["masked"]) + need_mask),
     }
@@ -1050,7 +1155,7 @@ def _run_scan_stream(
 
         prog = None
         global_key = (
-            _global_prog_key(prog_key, packer, dtypes, mesh) if not baked else None
+            _global_prog_key(prog_key, packer, mesh) if not baked else None
         )
         if global_key is not None:
             prog = _GLOBAL_PROGRAMS.get(global_key)

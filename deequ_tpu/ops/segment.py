@@ -237,8 +237,8 @@ def _resident_string_bincount(table, column: str, include_null: bool, mesh):
     )
     args = []
     for chunk in cache.device_chunks:
-        args.append(chunk[4])  # codes buffer
-        args.append(chunk[5])  # row_valid
+        args.append(chunk[5])  # codes buffer
+        args.append(chunk[6])  # row_valid
     return fn(*args)
 
 

@@ -411,3 +411,44 @@ def test_histogram_binning_udf_per_distinct():
     d3 = h3.calculate(t2).value.get()
     assert set(d3.values) == {"0", "1", "2"}
     assert d3.values["1"].absolute == 2
+
+
+def test_huge_magnitude_column_routes_wide_f64():
+    """Values beyond the f32-pair compute ceiling (~2^62) must route to
+    the wide-f64 path: squares/partial sums would overflow f32 (round-4
+    review finding). Mean/StdDev/Min/Max stay finite and exact."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Maximum, Mean, Minimum, StandardDeviation
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    vals = np.array([1e20, 2e20, 3e20, -1e20, 5e19] * 100)
+    table = ColumnarTable([Column("x", DType.FRACTIONAL, values=vals)])
+    ctx = AnalysisRunner.do_analysis_run(
+        table, [Mean("x"), StandardDeviation("x"), Minimum("x"), Maximum("x")]
+    )
+    mean = ctx.metric_map[Mean("x")].value.get()
+    std = ctx.metric_map[StandardDeviation("x")].value.get()
+    assert np.isfinite(mean) and np.isfinite(std)
+    assert mean == pytest.approx(vals.mean(), rel=1e-12)
+    assert std == pytest.approx(vals.std(), rel=1e-12)
+    assert ctx.metric_map[Minimum("x")].value.get() == -1e20
+    assert ctx.metric_map[Maximum("x")].value.get() == 3e20
+
+
+def test_host_fold_widens_int_counts_to_i64():
+    """Device counts are i32 per chunk; the HOST accumulator must widen to
+    i64 so >2^31-row streams don't wrap (round-4 review finding)."""
+    import jax
+    import numpy as np
+
+    from deequ_tpu.ops.scan_engine import _tag_reduce_np, _unflatten_partials
+
+    shapes = jax.eval_shape(lambda: {"n": np.int32(0)})
+    big = np.array([2**31 - 10], dtype=np.float64)
+    a = _unflatten_partials(big, shapes)
+    b = _unflatten_partials(big, shapes)
+    assert a["n"].dtype == np.int64
+    total = _tag_reduce_np("sum", a["n"], b["n"])
+    assert int(total) == 2 * (2**31 - 10)  # no i32 wrap

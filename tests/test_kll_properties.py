@@ -274,10 +274,19 @@ def test_persisted_exact_path_matches_sketch_rank_rule():
     assert v_stream == v_persist
 
     # even-count median: the historic divergence case (round-half-even vs
-    # ceil) — 200 values, q=0.5 picks element 99 under ceil(q*n)-1
+    # ceil) — 200 values, q=0.5 picks element 99 under ceil(q*n)-1. The
+    # scan ships values as two-float f32 pairs (ops/df32.py), so the item
+    # comes back at the pair-representable rounding of element 99 (~48-bit,
+    # rel err < 2^-47); comparing against the SPLIT of the exact element
+    # still pins the rank selection bit-for-bit (a neighbouring element
+    # would differ by ~9 orders of magnitude more).
+    from deequ_tpu.ops.df32 import split_pair_np
+
     sorted_v = np.sort(values)
+    h, l = split_pair_np(sorted_v[99:100])
+    representable = float(h[0]) + float(l[0])
     assert ApproxQuantile("x", 0.5).calculate(persisted).value.get() == (
-        sorted_v[99]
+        representable
     )
 
 

@@ -493,3 +493,32 @@ def test_kll_compact_all_null_column_bounded():
     compacted = _make_kll_compact(1, 256)(result)
     assert compacted["items"].size == 0
     assert compacted["weights"].size == 0
+
+
+def test_stream_csv_bool_mixed_literal_parity(tmp_path):
+    """A bool column mixing '1'/'true' literals: pyarrow read_csv infers
+    BOOLEAN (int64 fails on 'true', bool literal set includes '1'), and
+    stream_csv must agree (round-4 review finding)."""
+    p = tmp_path / "mixed_bool.csv"
+    rows = ["b"] + ["true", "1", "false", "0", "TRUE"] * 200
+    p.write_text("\n".join(rows) + "\n")
+
+    from deequ_tpu.data.io import read_csv
+    from deequ_tpu.data.io import stream_csv
+    from deequ_tpu.data.table import DType
+
+    batch_table = read_csv(str(p))
+    stream = stream_csv(str(p))
+    assert batch_table.schema["b"].dtype == DType.BOOLEAN
+    assert stream.schema["b"].dtype == DType.BOOLEAN
+
+    from deequ_tpu.analyzers import Completeness, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+
+    sctx = AnalysisRunner.do_analysis_run(stream, [Size(), Completeness("b")])
+    bctx = AnalysisRunner.do_analysis_run(batch_table, [Size(), Completeness("b")])
+    assert sctx.metric_map[Size()].value.get() == bctx.metric_map[Size()].value.get()
+    assert (
+        sctx.metric_map[Completeness("b")].value.get()
+        == bctx.metric_map[Completeness("b")].value.get()
+    )
