@@ -42,24 +42,122 @@ from deequ_tpu.ops.segment import group_counts
 from deequ_tpu.tryresult import Failure, Success
 
 
-@dataclass(frozen=True)
+def _cell_to_python(value, is_null: bool):
+    """Typed array cell -> the python object the dict API exposes."""
+    if is_null:
+        return None
+    if isinstance(value, np.generic):
+        value = value.item()
+    return value
+
+
+def _column_from_cells(cells: list):
+    """Python group cells (one grouping column) -> (typed values, nulls).
+
+    Chooses the narrowest homogeneous dtype (the merge factorizes these
+    with vectorized np.unique, which needs typed arrays — object arrays
+    would fall back to per-element python compares)."""
+    nulls = np.array([c is None for c in cells], dtype=bool)
+    present = [c for c in cells if c is not None]
+    if present and all(isinstance(c, bool) for c in present):
+        fill = False
+        dtype = np.bool_
+    elif present and all(isinstance(c, int) for c in present):
+        fill = 0
+        dtype = np.int64
+    elif present and all(isinstance(c, (int, float)) for c in present):
+        fill = 0.0
+        dtype = np.float64
+    else:
+        fill = ""
+        dtype = None  # np.str_, width from data
+    vals = [fill if c is None else c for c in cells]
+    if dtype is None:
+        values = np.array([str(v) for v in vals], dtype=np.str_)
+    else:
+        values = np.array(vals, dtype=dtype)
+    return values, nulls
+
+
 class FrequenciesAndNumRows(State):
     """Group frequencies + total row count (at least one grouping column
-    non-null). Merge = add counts across the union of groups."""
+    non-null). Merge = add counts across the union of groups.
 
-    columns: Tuple[str, ...]
-    frequencies: Tuple[Tuple[tuple, int], ...]  # sorted items, hashable
-    num_rows: int
+    COLUMNAR representation (round 4): one typed numpy array + null mask
+    per grouping column, plus an int64 counts vector — the merge, the
+    count-distribution metrics, MutualInformation, and serde are all
+    vectorized array ops, so a 100M-distinct grouping (BASELINE config 4)
+    never materializes python objects per group. The dict-shaped API
+    (``from_dict``/``as_dict``/``frequencies``) remains as a compatibility
+    boundary for tests and small states.
+    """
+
+    def __init__(
+        self,
+        columns: Sequence[str],
+        key_values: Tuple[np.ndarray, ...],
+        key_nulls: Tuple[np.ndarray, ...],
+        counts: np.ndarray,
+        num_rows: int,
+    ):
+        self.columns = tuple(columns)
+        self.key_values = tuple(np.asarray(v) for v in key_values)
+        self.key_nulls = tuple(
+            np.asarray(m, dtype=bool) for m in key_nulls
+        )
+        self.counts = np.asarray(counts, dtype=np.int64)
+        self.num_rows = int(num_rows)
+
+    # -- compatibility boundary (python dict of group tuples) ---------------
 
     @staticmethod
     def from_dict(
         columns: Sequence[str], frequencies: Dict[tuple, int], num_rows: int
     ) -> "FrequenciesAndNumRows":
-        items = tuple(sorted(frequencies.items(), key=lambda kv: repr(kv[0])))
-        return FrequenciesAndNumRows(tuple(columns), items, num_rows)
+        items = sorted(frequencies.items(), key=lambda kv: repr(kv[0]))
+        n_cols = len(tuple(columns))
+        key_values = []
+        key_nulls = []
+        for i in range(n_cols):
+            values, nulls = _column_from_cells([g[i] for g, _ in items])
+            key_values.append(values)
+            key_nulls.append(nulls)
+        counts = np.array([c for _, c in items], dtype=np.int64)
+        return FrequenciesAndNumRows(
+            tuple(columns), tuple(key_values), tuple(key_nulls), counts,
+            num_rows,
+        )
+
+    @property
+    def frequencies(self) -> Tuple[Tuple[tuple, int], ...]:
+        """Materialized ((cell, ...), count) items — compatibility accessor;
+        O(#groups) python objects, avoid on hot paths."""
+        groups = []
+        cols = [v.tolist() for v in self.key_values]
+        nulls = [m.tolist() for m in self.key_nulls]
+        counts = self.counts.tolist()
+        for g in range(len(counts)):
+            key = tuple(
+                None if nulls[i][g] else cols[i][g]
+                for i in range(len(cols))
+            )
+            groups.append((key, counts[g]))
+        return tuple(groups)
 
     def as_dict(self) -> Dict[tuple, int]:
         return dict(self.frequencies)
+
+    # -- vectorized core ----------------------------------------------------
+
+    def _code_columns(self, arrays=None, nulls=None):
+        """Factorize each key column -> dense int codes (0 = null)."""
+        arrays = self.key_values if arrays is None else arrays
+        nulls = self.key_nulls if nulls is None else nulls
+        codes = []
+        for v, nl in zip(arrays, nulls):
+            _, inv = np.unique(v, return_inverse=True)
+            codes.append(np.where(nl, 0, inv.reshape(v.shape) + 1))
+        return codes
 
     def sum(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
         if self.columns != other.columns:
@@ -67,19 +165,83 @@ class FrequenciesAndNumRows(State):
                 f"cannot merge frequency states over different columns: "
                 f"{self.columns} vs {other.columns}"
             )
-        merged = self.as_dict()
-        for group, count in other.frequencies:
-            merged[group] = merged.get(group, 0) + count
-        return FrequenciesAndNumRows.from_dict(
-            self.columns, merged, self.num_rows + other.num_rows
+        cat_vals = []
+        cat_nulls = []
+        _NUMERIC = set("iufb")
+        for (a, an), (b, bn) in zip(
+            zip(self.key_values, self.key_nulls),
+            zip(other.key_values, other.key_nulls),
+        ):
+            ka, kb = a.dtype.kind, b.dtype.kind
+            if ka != kb and not (ka in _NUMERIC and kb in _NUMERIC):
+                # mismatched key kinds across states: legitimate only when
+                # one side's column is entirely null (e.g. a legacy
+                # from_dict state of all-None cells defaults to a string
+                # dtype) — adopt the typed side. A genuine string-vs-
+                # numeric merge would silently stringify keys via
+                # promote_types, so refuse it loudly instead.
+                if bool(an.all()):
+                    a = np.zeros(len(a), dtype=b.dtype)
+                elif bool(bn.all()):
+                    b = np.zeros(len(b), dtype=a.dtype)
+                else:
+                    raise ValueError(
+                        f"cannot merge frequency states with mismatched "
+                        f"group-key types ({a.dtype} vs {b.dtype}) for "
+                        f"columns {self.columns}"
+                    )
+            # promote dtypes (e.g. two unicode widths, int64 vs float64 —
+            # numeric promotion matches dict semantics, where 5 and 5.0
+            # hash to the same key)
+            common = np.promote_types(a.dtype, b.dtype)
+            cat_vals.append(
+                np.concatenate([a.astype(common), b.astype(common)])
+            )
+            cat_nulls.append(np.concatenate([an, bn]))
+        cat_counts = np.concatenate([self.counts, other.counts])
+        if len(cat_counts) == 0:
+            return FrequenciesAndNumRows(
+                self.columns, tuple(cat_vals), tuple(cat_nulls), cat_counts,
+                self.num_rows + other.num_rows,
+            )
+        code_cols = self._code_columns(cat_vals, cat_nulls)
+        order = np.lexsort(tuple(reversed(code_cols)))
+        mat = np.stack(code_cols)[:, order]
+        boundary = np.any(mat[:, 1:] != mat[:, :-1], axis=0)
+        starts = np.concatenate([[0], np.nonzero(boundary)[0] + 1])
+        merged_counts = np.add.reduceat(cat_counts[order], starts)
+        sel = order[starts]
+        return FrequenciesAndNumRows(
+            self.columns,
+            tuple(v[sel] for v in cat_vals),
+            tuple(nl[sel] for nl in cat_nulls),
+            merged_counts.astype(np.int64),
+            self.num_rows + other.num_rows,
+        )
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, FrequenciesAndNumRows):
+            return NotImplemented
+        return (
+            self.columns == other.columns
+            and self.num_rows == other.num_rows
+            and self.as_dict() == other.as_dict()
+        )
+
+    __hash__ = None  # mutable ndarray payload; never used as a dict key
+
+    def __repr__(self) -> str:
+        return (
+            f"FrequenciesAndNumRows(columns={self.columns}, "
+            f"num_groups={self.num_groups}, num_rows={self.num_rows})"
         )
 
     @property
     def num_groups(self) -> int:
-        return len(self.frequencies)
+        return len(self.counts)
 
     def counts_array(self) -> np.ndarray:
-        return np.array([c for _, c in self.frequencies], dtype=np.int64)
+        return self.counts
 
 
 class FrequencyBasedAnalyzer(Analyzer):
@@ -102,8 +264,9 @@ class FrequencyBasedAnalyzer(Analyzer):
         return [at_least_one(cols)] + [has_column(c) for c in cols]
 
     def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
-        freqs, num_rows = group_counts(table, self.group_columns)
-        return FrequenciesAndNumRows.from_dict(self.group_columns, freqs, num_rows)
+        from deequ_tpu.ops.segment import group_counts_state
+
+        return group_counts_state(table, self.group_columns)
 
     def _stream_columns(self):
         return list(self.group_columns)
@@ -328,19 +491,21 @@ class MutualInformation(FrequencyBasedAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException(f"Empty state for analyzer {self!r}.")
             )
-        marginal_a: Dict[object, int] = {}
-        marginal_b: Dict[object, int] = {}
-        for (va, vb), c in state.frequencies:
-            marginal_a[va] = marginal_a.get(va, 0) + c
-            marginal_b[vb] = marginal_b.get(vb, 0) + c
-        mi = 0.0
-        for (va, vb), c in state.frequencies:
-            if va is None or vb is None:
-                continue
-            pxy = c / total
-            px = marginal_a[va] / total
-            py = marginal_b[vb] / total
-            mi += pxy * math.log(pxy / (px * py))
+        # vectorized over the columnar joint table: factorize each key
+        # column to dense codes, marginals via bincount, one fused log
+        # expression — no per-group python objects, so MI over millions of
+        # distinct pairs stays in array ops (reference computes this with
+        # two aggregation+join jobs, MutualInformation.scala:35-103)
+        code_a, code_b = state._code_columns()
+        counts = state.counts.astype(np.float64)
+        marginal_a = np.bincount(code_a, weights=counts)
+        marginal_b = np.bincount(code_b, weights=counts)
+        valid = (code_a > 0) & (code_b > 0)
+        c = counts[valid]
+        px = marginal_a[code_a[valid]] / total
+        py = marginal_b[code_b[valid]] / total
+        pxy = c / total
+        mi = float(np.sum(pxy * np.log(pxy / (px * py))))
         return metric_from_value(mi, "MutualInformation", self.instance, Entity.MULTICOLUMN)
 
     def to_failure_metric(self, exception: Exception) -> DoubleMetric:
@@ -362,6 +527,25 @@ def _stringify(value) -> str:
     if isinstance(value, float) and value.is_integer():
         return f"{value:.1f}"
     return str(value)
+
+
+def _stringify_arrays(values: np.ndarray, nulls: np.ndarray) -> np.ndarray:
+    """Vectorized ``_stringify`` over one typed key column (nulls ->
+    'NullValue'); must agree cell-for-cell with the scalar version."""
+    if values.dtype.kind in ("U", "S", "O"):
+        s = values.astype(np.str_)
+    elif values.dtype == np.bool_:
+        s = np.where(values, "true", "false")
+    elif values.dtype.kind in "iu":
+        s = values.astype(np.str_)
+    else:
+        with np.errstate(invalid="ignore"):
+            is_int = np.isfinite(values) & (values == np.floor(values))
+        s = np.where(
+            is_int, np.char.mod("%.1f", np.where(is_int, values, 0.0)),
+            values.astype(np.str_),
+        )
+    return np.where(nulls, NULL_FIELD_REPLACEMENT, s)
 
 
 @dataclass(frozen=True)
@@ -428,21 +612,34 @@ class Histogram(FrequencyBasedAnalyzer):
         )
 
     def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
+        from deequ_tpu.ops.segment import group_counts_state
+
         total_count = table.num_rows
         col = table[self.column]
         if self.binning_udf is not None:
             binned_table = ColumnarTable([self._binned_column(col)])
-            freqs, _ = group_counts(
+            raw = group_counts_state(
                 binned_table, [self.column], require_any_non_null=False
             )
         else:
-            freqs, _ = group_counts(table, [self.column], require_any_non_null=False)
-        # stringify group values, nulls -> NullValue (Histogram.scala:108-111)
-        str_freqs: Dict[tuple, int] = {}
-        for (value,), count in freqs.items():
-            key = (_stringify(value),)
-            str_freqs[key] = str_freqs.get(key, 0) + count
-        return FrequenciesAndNumRows.from_dict((self.column,), str_freqs, total_count)
+            raw = group_counts_state(
+                table, [self.column], require_any_non_null=False
+            )
+        # stringify group values, nulls -> NullValue (Histogram.scala:
+        # 108-111), merging label collisions (1 vs "1") — all vectorized
+        labels = _stringify_arrays(raw.key_values[0], raw.key_nulls[0])
+        if len(labels):
+            uniq, inv = np.unique(labels, return_inverse=True)
+            counts = np.bincount(
+                inv.reshape(-1), weights=raw.counts
+            ).astype(np.int64)
+        else:
+            uniq = np.empty(0, dtype=np.str_)
+            counts = np.zeros(0, dtype=np.int64)
+        return FrequenciesAndNumRows(
+            (self.column,), (uniq,), (np.zeros(len(uniq), dtype=bool),),
+            counts, total_count,
+        )
 
     def calculate(self, table, aggregate_with=None, save_states_with=None):
         # device top-N fast path: when nobody needs the mergeable frequency
@@ -494,12 +691,19 @@ class Histogram(FrequencyBasedAnalyzer):
             )
 
         def build() -> Distribution:
-            items = sorted(state.frequencies, key=lambda kv: kv[1], reverse=True)
-            top = items[: self.max_detail_bins]
-            details = {
-                key[0]: DistributionValue(count, count / state.num_rows)
-                for key, count in top
-            }
+            # top-N by count via argsort over the counts VECTOR; only the
+            # selected bins decode to python objects
+            counts = state.counts
+            k = min(self.max_detail_bins, len(counts))
+            order = np.argsort(-counts, kind="stable")[:k]
+            values = state.key_values[0]
+            nulls = state.key_nulls[0]
+            details = {}
+            for g in order.tolist():
+                cell = _cell_to_python(values[g], bool(nulls[g]))
+                details[cell] = DistributionValue(
+                    int(counts[g]), int(counts[g]) / state.num_rows
+                )
             return Distribution(details, number_of_bins=state.num_groups)
 
         from deequ_tpu.tryresult import Try

@@ -396,7 +396,7 @@ class AnalysisRunner:
         aggregate_with=None,
         save_states_with=None,
     ) -> AnalyzerContext:
-        from deequ_tpu.ops.segment import group_count_stats, group_counts
+        from deequ_tpu.ops.segment import group_count_stats, group_counts_state
 
         # out-of-core: fold the frequency monoid per batch (the same
         # outer-join-sum merge used for incremental states,
@@ -406,10 +406,7 @@ class AnalysisRunner:
             merged: Optional[FrequenciesAndNumRows] = None
             try:
                 for batch in data.batches(columns=grouping_columns):
-                    freqs, num_rows = group_counts(batch, grouping_columns)
-                    s = FrequenciesAndNumRows.from_dict(
-                        grouping_columns, freqs, num_rows
-                    )
+                    s = group_counts_state(batch, grouping_columns)
                     merged = s if merged is None else merged.sum(s)
             except Exception as e:  # noqa: BLE001
                 wrapped = wrap_if_necessary(e)
@@ -418,15 +415,8 @@ class AnalysisRunner:
                 )
             ctx = AnalyzerContext.empty()
             for analyzer in analyzers:
-                own_state = (
-                    FrequenciesAndNumRows.from_dict(
-                        grouping_columns, merged.as_dict(), merged.num_rows
-                    )
-                    if merged is not None
-                    else None
-                )
                 ctx.metric_map[analyzer] = analyzer.calculate_metric(
-                    own_state, aggregate_with, save_states_with
+                    merged, aggregate_with, save_states_with
                 )
             return ctx
 
@@ -468,10 +458,7 @@ class AnalysisRunner:
             )
 
         try:
-            freqs, num_rows = group_counts(data, grouping_columns)
-            state: Optional[State] = FrequenciesAndNumRows.from_dict(
-                grouping_columns, freqs, num_rows
-            )
+            state: Optional[State] = group_counts_state(data, grouping_columns)
         except Exception as e:  # noqa: BLE001
             wrapped = wrap_if_necessary(e)
             return AnalyzerContext(
@@ -479,13 +466,8 @@ class AnalysisRunner:
             )
         ctx = AnalyzerContext.empty()
         for analyzer in analyzers:
-            # each analyzer re-keys the shared state under its own column
-            # order for persistence (reference keys states per analyzer)
-            own_state = FrequenciesAndNumRows.from_dict(
-                grouping_columns, dict(state.frequencies), state.num_rows
-            )
             ctx.metric_map[analyzer] = analyzer.calculate_metric(
-                own_state, aggregate_with, save_states_with
+                state, aggregate_with, save_states_with
             )
         return ctx
 

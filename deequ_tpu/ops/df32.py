@@ -120,7 +120,13 @@ def _f32 (xp, x):
 def _pair_tree_sum(s, c, xp, levels: int = TREE_LEVELS):
     """Reduce (s, c) f32 arrays to one f64 scalar: `levels` halving rounds
     of TwoSum with exact error accumulation, then an f64 tail reduce over
-    the n/2^levels survivors."""
+    the n/2^levels survivors.
+
+    Non-finite inputs poison TwoSum's error channel (inf - inf = NaN), so
+    a plain f32 sum of the raw planes rides along as the IEEE-correct
+    fallback: inf columns sum to inf (or NaN for mixed-sign infs / NaN
+    data), matching the f64 path and the reference's JVM doubles."""
+    naive = (xp.sum(s) + xp.sum(c)).astype(xp.float64)
     for _ in range(levels):
         m = s.shape[0]
         if m <= 1:
@@ -133,7 +139,11 @@ def _pair_tree_sum(s, c, xp, levels: int = TREE_LEVELS):
         half = m // 2
         s, err = two_sum(s[:half], s[half:])
         c = c[:half] + c[half:] + err
-    return xp.sum(s.astype(xp.float64)) + xp.sum(c.astype(xp.float64))
+    tree = xp.sum(s.astype(xp.float64)) + xp.sum(c.astype(xp.float64))
+    # tree is NaN only when non-finite values were present (finite inputs
+    # cannot overflow under PAIR_SAFE_MAX); the naive sum then carries the
+    # correct IEEE result
+    return xp.where(xp.isnan(tree), naive, tree)
 
 
 def masked_sum(hi, lo, ok, xp):

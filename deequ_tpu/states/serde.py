@@ -12,7 +12,11 @@ below; golden byte fixtures in tests/test_state_serde.py pin the format.
 
 Version history: v1 original; v2 appends the compaction-RNG position (i64)
 to the KLL payload (decoders keep reading v1, where it is absent and
-defaults to 0). Every payload decoder receives the envelope version.
+defaults to 0); v3 re-encodes FrequenciesAndNumRows as COLUMNAR blocks
+(one typed array per grouping column + a counts vector) so encode/decode
+are vectorized numpy ops instead of per-group loops — v1/v2 per-cell
+frequency payloads still decode. Every payload decoder receives the
+envelope version.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ from typing import Callable, Dict, Tuple, Type
 from deequ_tpu.analyzers.base import State
 
 MAGIC = b"DQTS"
-VERSION = 2
+VERSION = 3
 
 _u16 = struct.Struct("<H")
 _i64 = struct.Struct("<q")
@@ -171,20 +175,50 @@ def _dec_kll(buf: bytes, version: int):
     return KLLState(sketch, gmin, gmax)
 
 
+# columnar key-array kinds (v3 frequency payloads)
+_KCOL_STR, _KCOL_INT, _KCOL_FLOAT, _KCOL_BOOL = range(4)
+
+
 def _enc_freq(state) -> bytes:
+    """v3: columnar — vectorized array blobs, no per-group python loop."""
+    import numpy as np
+
+    G = state.num_groups
     out = [_i64.pack(len(state.columns))]
     for c in state.columns:
         out.append(_pack_str(c))
     out.append(_i64.pack(state.num_rows))
-    out.append(_i64.pack(len(state.frequencies)))
-    for group, count in state.frequencies:
-        for cell in group:
-            out.append(_pack_cell(cell))
-        out.append(_i64.pack(count))
+    out.append(_i64.pack(G))
+    out.append(np.ascontiguousarray(state.counts, dtype="<i8").tobytes())
+    for values, nulls in zip(state.key_values, state.key_nulls):
+        out.append(np.packbits(np.asarray(nulls, dtype=bool)).tobytes())
+        kind = values.dtype.kind
+        if kind in ("U", "S", "O"):
+            # raw little-endian UCS4 fixed-width block: ~4x the bytes of
+            # utf-8 but encode AND decode are single vectorized buffer
+            # copies — per-group python joins/decodes measured 30x slower
+            # than the whole analysis at 1M groups
+            svals = values.astype(np.str_)
+            width = max(svals.dtype.itemsize // 4, 1)
+            blob = np.ascontiguousarray(svals.astype(f"<U{width}")).tobytes()
+            out.append(bytes([_KCOL_STR]))
+            out.append(_i64.pack(width))
+            out.append(blob)
+        elif values.dtype == np.bool_:
+            out.append(bytes([_KCOL_BOOL]))
+            out.append(np.packbits(values).tobytes())
+        elif kind in "iu":
+            out.append(bytes([_KCOL_INT]))
+            out.append(np.ascontiguousarray(values, dtype="<i8").tobytes())
+        else:
+            out.append(bytes([_KCOL_FLOAT]))
+            out.append(np.ascontiguousarray(values, dtype="<f8").tobytes())
     return b"".join(out)
 
 
 def _dec_freq(buf: bytes, version: int):
+    import numpy as np
+
     from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
 
     off = 0
@@ -195,15 +229,59 @@ def _dec_freq(buf: bytes, version: int):
         columns.append(c)
     (num_rows,) = _i64.unpack_from(buf, off); off += 8
     (n_groups,) = _i64.unpack_from(buf, off); off += 8
-    freqs = {}
-    for _ in range(n_groups):
-        group = []
-        for _ in range(n_cols):
-            cell, off = _unpack_cell(buf, off)
-            group.append(cell)
-        (count,) = _i64.unpack_from(buf, off); off += 8
-        freqs[tuple(group)] = count
-    return FrequenciesAndNumRows.from_dict(columns, freqs, num_rows)
+
+    if version < 3:
+        # v1/v2: interleaved per-group cells
+        freqs = {}
+        for _ in range(n_groups):
+            group = []
+            for _ in range(n_cols):
+                cell, off = _unpack_cell(buf, off)
+                group.append(cell)
+            (count,) = _i64.unpack_from(buf, off); off += 8
+            freqs[tuple(group)] = count
+        return FrequenciesAndNumRows.from_dict(columns, freqs, num_rows)
+
+    G = n_groups
+    counts = np.frombuffer(buf, dtype="<i8", count=G, offset=off).copy()
+    off += 8 * G
+    nbytes_mask = (G + 7) // 8
+    key_values = []
+    key_nulls = []
+    for _ in range(n_cols):
+        nulls = np.unpackbits(
+            np.frombuffer(buf, dtype=np.uint8, count=nbytes_mask, offset=off),
+            count=G,
+        ).astype(bool)
+        off += nbytes_mask
+        kind = buf[off]; off += 1
+        if kind == _KCOL_STR:
+            (width,) = _i64.unpack_from(buf, off); off += 8
+            values = np.frombuffer(
+                buf, dtype=f"<U{width}", count=G, offset=off
+            ).copy() if G else np.empty(0, dtype=np.str_)
+            off += 4 * width * G
+        elif kind == _KCOL_BOOL:
+            values = np.unpackbits(
+                np.frombuffer(
+                    buf, dtype=np.uint8, count=nbytes_mask, offset=off
+                ),
+                count=G,
+            ).astype(bool)
+            off += nbytes_mask
+        elif kind == _KCOL_INT:
+            values = np.frombuffer(buf, dtype="<i8", count=G, offset=off).copy()
+            off += 8 * G
+        elif kind == _KCOL_FLOAT:
+            values = np.frombuffer(buf, dtype="<f8", count=G, offset=off).copy()
+            off += 8 * G
+        else:
+            raise ValueError(f"unknown key-column kind {kind}")
+        key_values.append(values)
+        key_nulls.append(nulls)
+    return FrequenciesAndNumRows(
+        tuple(columns), tuple(key_values), tuple(key_nulls), counts, num_rows
+    )
 
 
 def _registry() -> Dict[Type[State], Tuple[int, Callable, Callable]]:

@@ -317,3 +317,121 @@ def test_count_stats_fast_path_matches_full_path():
         vf = fast.metric_map[a].value.get()
         vz = full.metric_map[a].value.get()
         assert abs(vf - vz) < 1e-12, (a, vf, vz)
+
+
+def test_columnar_frequency_state_matches_dict_semantics():
+    """Round-4 columnar FrequenciesAndNumRows: vectorized merge and MI must
+    agree exactly with the dict-based semantics on a mixed-type grouping
+    with nulls, and the state provider path must match the fast path."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import MutualInformation, Uniqueness
+    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.segment import group_counts_state
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(9)
+    n = 20_000
+    codes = rng.integers(-1, 500, n).astype(np.int32)  # -1 = null
+    dictionary = np.array([f"k{i}" for i in range(500)], dtype=object)
+    ints = rng.integers(0, 50, n)
+    int_mask = rng.random(n) > 0.05
+    table = ColumnarTable([
+        Column("s", DType.STRING, codes=codes, dictionary=dictionary),
+        Column("i", DType.INTEGRAL, values=ints, mask=int_mask),
+    ])
+
+    # columnar state == dict state
+    state = group_counts_state(table, ["s", "i"])
+    expect = {}
+    for c, v, m in zip(codes.tolist(), ints.tolist(), int_mask.tolist()):
+        key = (None if c < 0 else f"k{c}", v if m else None)
+        if key == (None, None):
+            continue
+        expect[key] = expect.get(key, 0) + 1
+    assert state.as_dict() == expect
+
+    # vectorized merge == dict merge
+    half = n // 2
+    t1 = ColumnarTable([
+        Column("s", DType.STRING, codes=codes[:half], dictionary=dictionary),
+        Column("i", DType.INTEGRAL, values=ints[:half], mask=int_mask[:half]),
+    ])
+    t2 = ColumnarTable([
+        Column("s", DType.STRING, codes=codes[half:], dictionary=dictionary),
+        Column("i", DType.INTEGRAL, values=ints[half:], mask=int_mask[half:]),
+    ])
+    merged = group_counts_state(t1, ["s", "i"]).sum(group_counts_state(t2, ["s", "i"]))
+    assert merged == state
+
+    # stateful run == fast-path run
+    a = Uniqueness(("s",))
+    fast = AnalysisRunner.do_analysis_run(table, [a]).metric_map[a].value.get()
+    stateful = AnalysisRunner.do_analysis_run(
+        table, [a], save_states_with=InMemoryStateProvider()
+    ).metric_map[a].value.get()
+    assert fast == stateful
+
+    # vectorized MI == dict-loop MI
+    mi_an = MutualInformation("s", "i")
+    mi = AnalysisRunner.do_analysis_run(table, [mi_an]).metric_map[mi_an].value.get()
+    import math
+    total = state.num_rows
+    ma, mb = {}, {}
+    for (va, vb), c in state.frequencies:
+        ma[va] = ma.get(va, 0) + c
+        mb[vb] = mb.get(vb, 0) + c
+    ref = 0.0
+    for (va, vb), c in state.frequencies:
+        if va is None or vb is None:
+            continue
+        pxy = c / total
+        ref += pxy * math.log(pxy / ((ma[va] / total) * (mb[vb] / total)))
+    assert abs(mi - ref) < 1e-12
+
+
+def test_pair_sum_inf_columns_keep_ieee_semantics():
+    """Columns containing +/-inf stay on the pair path (pair_safe checks
+    finite values only); sums must return the IEEE result (inf / NaN), not
+    the NaN that TwoSum's inf - inf error channel produces."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Mean, Sum
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    base = [1.0, 2.0, 3.0] * 64
+    pos_inf = ColumnarTable(
+        [Column("x", DType.FRACTIONAL, values=np.array(base + [np.inf]))]
+    )
+    v = AnalysisRunner.do_analysis_run(pos_inf, [Sum("x")]).metric_map[
+        Sum("x")
+    ].value.get()
+    assert v == np.inf
+    mixed = ColumnarTable(
+        [Column("x", DType.FRACTIONAL, values=np.array(base + [np.inf, -np.inf]))]
+    )
+    m = AnalysisRunner.do_analysis_run(mixed, [Mean("x")]).metric_map[
+        Mean("x")
+    ].value.get()
+    assert np.isnan(m)
+
+
+def test_frequency_merge_all_null_side_adopts_typed_keys():
+    """Merging a legacy all-null-keys state (string-dtype default) with a
+    typed int state must keep int keys, not stringify them; genuinely
+    mismatched key types refuse loudly."""
+    import pytest as _pytest
+
+    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
+
+    legacy = FrequenciesAndNumRows.from_dict(("g",), {(None,): 3}, 3)
+    typed = FrequenciesAndNumRows.from_dict(("g",), {(5,): 2}, 2)
+    merged = legacy.sum(typed)
+    assert merged.as_dict() == {(None,): 3, (5,): 2}
+
+    strs = FrequenciesAndNumRows.from_dict(("g",), {("a",): 1}, 1)
+    with _pytest.raises(ValueError, match="mismatched group-key types"):
+        typed.sum(strs)

@@ -77,7 +77,7 @@ def test_golden_num_matches():
     data = serialize_state(NumMatches(42))
     assert data.hex() == (
         "44515453"  # magic DQTS
-        "0200"      # version 2
+        "0300"      # version 3
         "0100"      # tag 1
         "2a00000000000000"  # i64 42
     )
@@ -86,7 +86,7 @@ def test_golden_num_matches():
 def test_golden_mean_state():
     data = serialize_state(MeanState(1.5, 3))
     assert data.hex() == (
-        "44515453" "0200" "0500"
+        "44515453" "0300" "0500"
         "000000000000f83f"  # f64 1.5 LE
         "0300000000000000"  # i64 3
     )
@@ -96,7 +96,7 @@ def test_golden_hll_prefix():
     regs = tuple([2, 0, 5] + [0] * 509)
     data = serialize_state(ApproxCountDistinctState(regs))
     assert data.hex().startswith(
-        "44515453" "0200" "0a00"
+        "44515453" "0300" "0a00"
         "0002000000000000"  # i64 512 (0x200)
         "020005"            # first three registers as bytes
     )
@@ -156,3 +156,29 @@ def test_newer_version_raises():
     data[4:6] = (99).to_bytes(2, "little")
     with pytest.raises(ValueError):
         deserialize_state(bytes(data))
+
+
+def test_frequency_state_v2_blob_still_decodes():
+    """v1/v2 frequency payloads were per-group cell streams; v3 is
+    columnar. Old persisted blobs must keep loading (the serde contract:
+    every older version stays decodable forever)."""
+    import struct
+
+    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
+    from deequ_tpu.states.serde import deserialize_state
+
+    def pack_str(s):
+        raw = s.encode("utf-8")
+        return struct.pack("<q", len(raw)) + raw
+
+    # hand-build a v2 envelope: columns=('g',), groups {('a',): 2, (None,): 1}
+    payload = struct.pack("<q", 1) + pack_str("g")
+    payload += struct.pack("<q", 3)  # num_rows
+    payload += struct.pack("<q", 2)  # n_groups
+    payload += bytes([1]) + pack_str("a") + struct.pack("<q", 2)  # CELL_STR
+    payload += bytes([0]) + struct.pack("<q", 1)  # CELL_NULL
+    blob = b"DQTS" + struct.pack("<HH", 2, 12) + payload
+    state = deserialize_state(blob)
+    assert isinstance(state, FrequenciesAndNumRows)
+    assert state.as_dict() == {("a",): 2, (None,): 1}
+    assert state.num_rows == 3
