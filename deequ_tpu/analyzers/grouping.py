@@ -38,7 +38,7 @@ from deequ_tpu.metrics import (
     Entity,
     HistogramMetric,
 )
-from deequ_tpu.ops.segment import group_counts
+from deequ_tpu.ops.segment import group_counts_state
 from deequ_tpu.tryresult import Failure, Success
 
 
@@ -56,18 +56,30 @@ def _column_from_cells(cells: list):
 
     Chooses the narrowest homogeneous dtype (the merge factorizes these
     with vectorized np.unique, which needs typed arrays — object arrays
-    would fall back to per-element python compares)."""
+    would fall back to per-element python compares). Numeric mixing
+    (bool/int/float) follows python-dict key semantics (True == 1,
+    5 == 5.0 share a slot); strings mixed with non-strings have NO
+    faithful typed representation (stringifying would silently merge 5
+    with '5'), so that refuses loudly."""
     nulls = np.array([c is None for c in cells], dtype=bool)
     present = [c for c in cells if c is not None]
     if present and all(isinstance(c, bool) for c in present):
         fill = False
         dtype = np.bool_
-    elif present and all(isinstance(c, int) for c in present):
+    elif present and all(
+        isinstance(c, int) and not isinstance(c, bool) for c in present
+    ):
         fill = 0
         dtype = np.int64
     elif present and all(isinstance(c, (int, float)) for c in present):
         fill = 0.0
         dtype = np.float64
+    elif present and not all(isinstance(c, str) for c in present):
+        raise TypeError(
+            "group keys mix strings with non-strings in one column; "
+            "the columnar frequency state cannot represent that without "
+            "silently collapsing keys like 5 and '5'"
+        )
     else:
         fill = ""
         dtype = None  # np.str_, width from data
@@ -192,7 +204,17 @@ class FrequenciesAndNumRows(State):
                     )
             # promote dtypes (e.g. two unicode widths, int64 vs float64 —
             # numeric promotion matches dict semantics, where 5 and 5.0
-            # hash to the same key)
+            # hash to the same key). int -> float64 is only faithful below
+            # 2^53; beyond that distinct int keys would silently collapse
+            for arr in (a, b):
+                if arr.dtype.kind == "i" and {ka, kb} == {"i", "f"} and len(
+                    arr
+                ) and int(np.abs(arr).max()) > 2 ** 53:
+                    raise ValueError(
+                        "cannot merge int group keys above 2^53 with a "
+                        "float-keyed state: float64 promotion would "
+                        "collapse distinct keys"
+                    )
             common = np.promote_types(a.dtype, b.dtype)
             cat_vals.append(
                 np.concatenate([a.astype(common), b.astype(common)])
@@ -264,8 +286,6 @@ class FrequencyBasedAnalyzer(Analyzer):
         return [at_least_one(cols)] + [has_column(c) for c in cols]
 
     def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
-        from deequ_tpu.ops.segment import group_counts_state
-
         return group_counts_state(table, self.group_columns)
 
     def _stream_columns(self):
@@ -612,8 +632,6 @@ class Histogram(FrequencyBasedAnalyzer):
         )
 
     def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
-        from deequ_tpu.ops.segment import group_counts_state
-
         total_count = table.num_rows
         col = table[self.column]
         if self.binning_udf is not None:

@@ -86,3 +86,18 @@ def test_host_row_range_balanced(monkeypatch):
     # single process owns the whole table
     patch(1, 0)
     assert host_row_range(7) == (0, 7)
+
+
+def test_multihost_cross_process_state_merge():
+    """Execute the multi-host (DCN) path end to end: two real OS processes
+    under jax.distributed, per-host shard ingestion via host_row_range,
+    per-host fused-scan states over the local mesh, cross-process
+    all_gather exchange over the global mesh, and monoid fold — merged
+    metrics must equal a single-host full-table run (SURVEY.md §2.15)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as g
+
+    g.dryrun_multihost(2, devices_per_process=2)

@@ -41,8 +41,14 @@ STATES = [
     DataTypeHistogram(1, 2, 3, 4, 5),
     ApproxCountDistinctState(tuple(np.arange(512) % 9)),
     _kll_state(),
+    # one state per key-column type (str/int + bool/float): a single
+    # column mixing strings with non-strings is deliberately unsupported
+    # by the columnar representation (it would collapse 5 and '5')
     FrequenciesAndNumRows.from_dict(
-        ("a", "b"), {("x", 1): 3, (None, 2.5): 1, (True, None): 2}, 6
+        ("a", "b"), {("x", 1): 3, (None, 2): 1, ("y", None): 2}, 6
+    ),
+    FrequenciesAndNumRows.from_dict(
+        ("c", "d"), {(True, 2.5): 4, (False, None): 1, (None, -0.5): 2}, 7
     ),
 ]
 
