@@ -61,6 +61,9 @@ from deequ_tpu.metrics import (  # noqa: E402
 from deequ_tpu.data.table import ColumnarTable  # noqa: E402
 from deequ_tpu.data.streaming import StreamingTable, stream_table  # noqa: E402
 from deequ_tpu.data.source import ParquetBatchSource  # noqa: E402
+from deequ_tpu.analyzers.incremental import (  # noqa: E402
+    IncrementalAnalysisStream,
+)
 from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
 from deequ_tpu.verification import (  # noqa: E402
     VerificationResult,

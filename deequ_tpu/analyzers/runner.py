@@ -280,15 +280,17 @@ class AnalysisRunner:
         return exec_ops, plan
 
     @staticmethod
-    def _run_scanning_analyzers(
+    def _dispatch_scanning_analyzers(
         data: ColumnarTable,
         analyzers: Sequence[ScanShareableAnalyzer],
-        aggregate_with=None,
-        save_states_with=None,
-    ) -> AnalyzerContext:
-        if not analyzers:
-            return AnalyzerContext.empty()
+        defer: bool = False,
+    ):
+        """Build + dispatch the fused scan. Returns (ctx_with_failures,
+        scannable, plan, scan) where scan is the results list (or a
+        DeferredScan when defer=True), or None when nothing scanned."""
         ctx = AnalyzerContext.empty()
+        if not analyzers:
+            return ctx, [], [], None
         # per-analyzer op construction errors (e.g. a malformed where
         # expression) fail only that analyzer, not the whole scan
         ops = []
@@ -306,16 +308,27 @@ class AnalysisRunner:
                     wrap_if_necessary(e)
                 )
         if not scannable:
-            return ctx
+            return ctx, [], [], None
         try:
             exec_ops, plan = AnalysisRunner._coalesce_scan_ops(ops)
-            results = run_scan(data, exec_ops)
+            scan = run_scan(data, exec_ops, defer=defer)
         except Exception as e:  # noqa: BLE001 — a failure inside the shared
             # scan maps onto every participating analyzer (reference L320-323)
             wrapped = wrap_if_necessary(e)
             for a in scannable:
                 ctx.metric_map[a] = a.to_failure_metric(wrapped)
-            return ctx
+            return ctx, [], [], None
+        return ctx, scannable, plan, scan
+
+    @staticmethod
+    def _finalize_scanning_analyzers(
+        ctx: AnalyzerContext,
+        scannable,
+        plan,
+        results,
+        aggregate_with=None,
+        save_states_with=None,
+    ) -> AnalyzerContext:
         for analyzer, (exec_idx, extract) in zip(scannable, plan):
             try:
                 result = results[exec_idx]
@@ -331,6 +344,22 @@ class AnalysisRunner:
                 state, aggregate_with, save_states_with
             )
         return ctx
+
+    @staticmethod
+    def _run_scanning_analyzers(
+        data: ColumnarTable,
+        analyzers: Sequence[ScanShareableAnalyzer],
+        aggregate_with=None,
+        save_states_with=None,
+    ) -> AnalyzerContext:
+        ctx, scannable, plan, scan = (
+            AnalysisRunner._dispatch_scanning_analyzers(data, analyzers)
+        )
+        if scan is None:
+            return ctx
+        return AnalysisRunner._finalize_scanning_analyzers(
+            ctx, scannable, plan, scan, aggregate_with, save_states_with
+        )
 
     @staticmethod
     def _run_own_pass_streaming(

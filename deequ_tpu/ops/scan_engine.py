@@ -839,20 +839,106 @@ class _PartialFolder:
             self.merged = out
 
 
+class DeferredScan:
+    """An in-flight fused scan: dispatch has happened, device results have
+    NOT been fetched. ``result()`` drains — calling it is the one host
+    round trip. Lets incremental pipelines keep several batches' scans in
+    flight (analyzers/incremental.py) so the per-fetch tunnel/PCIe latency
+    amortizes across batches instead of serializing them."""
+
+    def __init__(self, folder: _PartialFolder, in_flight, t_start: float):
+        self._folder = folder
+        self._in_flight = in_flight
+        self._t_start = t_start
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    def result(self) -> List[Any]:
+        if not self._done:
+            import time as _time
+
+            # deferred scans bill only the BLOCKING drain segment (the
+            # dispatch side is already in dispatch_seconds): wall between
+            # dispatch and drain belongs to the caller, and with several
+            # scans in flight it would double-count
+            t0 = _time.time()
+            for device_result in self._in_flight:
+                self._folder.drain(device_result)
+            self._in_flight = []
+            SCAN_STATS.scan_seconds += _time.time() - t0
+            self._done = True
+        if self._error is not None:
+            raise self._error
+        return self._folder.merged
+
+
+def fetch_deferred(scans: Sequence["DeferredScan"]) -> None:
+    """Drain several DeferredScans with ONE device->host fetch.
+
+    Each scan's pending chunk results are tiny flat f64 vectors; on links
+    where fetches serialize at a fixed round-trip latency (this
+    environment's tunnel: ~100ms PER FETCH, regardless of size), fetching
+    them one scan at a time makes an incremental loop latency-bound. Here
+    every pending vector concatenates ON DEVICE (one async dispatch) and
+    comes back in a single fetch; the slices then feed each scan's folder
+    in order. After this, ``result()`` on every scan is free."""
+    import time as _time
+
+    pending = [s for s in scans if not s._done and s._in_flight]
+    if not pending:
+        return
+    t0 = _time.time()
+    arrays = [a for s in pending for a in s._in_flight]
+    if len(arrays) == 1:
+        host = np.asarray(arrays[0])
+        parts = [host]
+    else:
+        sizes = [int(a.shape[0]) for a in arrays]
+        cat = jnp.concatenate(arrays)
+        host = np.asarray(cat)  # the one round trip
+        parts = []
+        off = 0
+        for size in sizes:
+            parts.append(host[off:off + size])
+            off += size
+    i = 0
+    for s in pending:
+        n_parts = len(s._in_flight)
+        try:
+            for k in range(n_parts):
+                s._folder.drain(parts[i + k])
+        except Exception as e:  # noqa: BLE001 — isolate per scan: a bad
+            # fold (e.g. a KLL compaction error) fails ITS scan's
+            # analyzers at result(), not the whole drained group
+            s._error = e
+        i += n_parts
+        s._in_flight = []
+        s._done = True
+    SCAN_STATS.scan_seconds += _time.time() - t0
+
+
 def run_scan(
     table,
     ops: Sequence[ScanOp],
     chunk_rows: Optional[int] = None,
     mesh=None,
+    defer: bool = False,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
 
-    Returns one reduced numpy pytree per op.
+    Returns one reduced numpy pytree per op — or, with ``defer=True`` (in-
+    memory tables only), a ``DeferredScan`` whose ``result()`` fetches
+    them later.
     """
     if mesh is None:
         mesh = current_mesh()
     if getattr(table, "is_streaming", False):
+        if defer:
+            raise ValueError(
+                "defer=True is for in-memory batch tables; streaming scans "
+                "already pipeline internally"
+            )
         return _run_scan_stream(table, ops, chunk_rows, mesh)
     n_rows = table.num_rows
     needed = sorted({c for op in ops for c in op.columns})
@@ -963,10 +1049,177 @@ def run_scan(
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
-    for device_result in in_flight:
-        folder.drain(device_result)
-    SCAN_STATS.scan_seconds += _time.time() - t_start
-    return folder.merged
+    deferred = DeferredScan(folder, in_flight, t_start)
+    if defer:
+        return deferred
+    return deferred.result()
+
+
+# -- micro-batched group scan (incremental pipelines) -----------------------
+
+
+class DeferredGroupScan:
+    """K batches' scans fused into ONE dispatch + ONE fetch (vmapped over
+    a leading batch axis). ``results()`` drains once and returns one
+    reduced-pytree list per table, identical to K separate run_scan calls
+    (same pure per-chunk function, vmapped)."""
+
+    def __init__(self, device_out, folders, t_start):
+        self._device_out = device_out
+        self._folders = folders
+        self._t_start = t_start
+        self._results: Optional[list] = None
+
+    def results(self) -> list:
+        if self._results is None:
+            import time as _time
+
+            t0 = _time.time()
+            host = np.asarray(self._device_out)  # the one round trip
+            out = []
+            for k, folder in enumerate(self._folders):
+                folder.drain(host[k])
+                out.append(folder.merged)
+            SCAN_STATS.scan_seconds += _time.time() - t0
+            self._results = out
+        return self._results
+
+
+def group_scannable(tables, ops, mesh) -> bool:
+    """True when run_scan_group supports this workload: single-device,
+    EQUAL-SIZE batches whose NEEDED columns are numeric and share one
+    schema, ops without dictionary LUTs (per-batch dictionaries would
+    need per-batch lut arguments). Equal sizes keep the group path
+    bit-identical to per-batch scans: padding a batch to a larger chunk
+    changes the f32-pair reduction association at the ulp level, which
+    the pipelined==serial contract forbids (unequal batches fall back to
+    per-batch deferred scans, which are exactly the serial programs)."""
+    if mesh is not None:
+        return False
+    if any(op.luts or op.dictionary_baked for op in ops):
+        return False
+    needed = sorted({c for op in ops for c in op.columns})
+    first = tables[0]
+    if any(n not in first for n in needed):
+        return False
+    sig = [(n, first[n].dtype) for n in needed]
+    n_rows = first.num_rows
+    for t in tables:
+        if getattr(t, "is_streaming", False) or t.num_rows == 0:
+            return False
+        if t.num_rows != n_rows:
+            return False
+        if any(n not in t for n in needed):
+            return False
+        if [(n, t[n].dtype) for n in needed] != sig:
+            return False
+        if any(t[n].dtype == DType.STRING for n, _ in sig):
+            return False
+    return True
+
+
+def run_scan_group(
+    tables: Sequence[ColumnarTable],
+    ops: Sequence[ScanOp],
+    defer: bool = True,
+):
+    """One fused pass over K same-schema batches: pack each into the same
+    single-chunk layout, stack to (K, ...) buffers, run ONE vmapped jitted
+    step, fetch ONE (K, S) result. The micro-batching behind
+    IncrementalAnalysisStream: on fetch-latency-bound links (the dev
+    tunnel serializes every fetch AND dependent dispatch at ~100ms) this
+    divides the per-batch round-trip cost by K; on production hosts it
+    amortizes per-dispatch overhead. Caller must have checked
+    group_scannable()."""
+    K = len(tables)
+    needed = sorted({c for op in ops for c in op.columns})
+    max_rows = max(t.num_rows for t in tables)
+    chunk = max(1, max_rows)
+
+    # one packer layout for the whole group: start from the first batch
+    # and fold the same monotone upgrades the streaming scan uses
+    # (narrow -> wide, pair -> wide, unmasked -> masked)
+    first_cols = {name: tables[0][name] for name in needed}
+    union = _ChunkPacker(first_cols, chunk).layout()
+    for t in tables[1:]:
+        cols_t = {name: t[name] for name in needed}
+        upgraded = _layout_upgrades(union, cols_t)
+        if upgraded is not None:
+            union = upgraded
+    packer = _ChunkPacker(first_cols, chunk, layout=union)
+
+    # stack per-table packed buffers along a leading K axis
+    stacked = None
+    for t in tables:
+        cols = {name: t[name] for name in needed}
+        p = _ChunkPacker(cols, chunk, layout=union)
+        args = p.pack(0, t.num_rows)
+        SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
+        if stacked is None:
+            stacked = [[a] for a in args]
+        else:
+            for lst, a in zip(stacked, args):
+                lst.append(a)
+    bufs = tuple(np.stack(lst) for lst in stacked)
+
+    prog_key = _ops_prog_key(ops, chunk, ())
+    global_key = None
+    if prog_key is not None:
+        gk = _global_prog_key(prog_key, packer, None)
+        if gk is not None:
+            global_key = ("group", K, gk)
+    cached = _GLOBAL_PROGRAMS.get(global_key) if global_key else None
+
+    if cached is not None:
+        vstep, shapes = cached
+        SCAN_STATS.programs_reused += 1
+    else:
+        SCAN_STATS.programs_built += 1
+        view = packer.unpack_view()
+
+        def single_tree(values, hi, lo, narrow_i, masks, codes, row_valid):
+            vals = view.unpack_vals(
+                values, hi, lo, narrow_i, masks, codes, jnp, row_valid,
+            )
+            return tuple(
+                jax.tree.map(
+                    _tag_identity_wrap,
+                    op.tags,
+                    op.update(vals, row_valid, jnp, chunk),
+                )
+                for op in ops
+            )
+
+        def single_flat(*args):
+            leaves = jax.tree.leaves(single_tree(*args))
+            return jnp.concatenate(
+                [jnp.ravel(leaf).astype(jnp.float64) for leaf in leaves]
+            )
+
+        vstep = jax.jit(jax.vmap(single_flat))
+        shapes = jax.eval_shape(single_tree, *(b[0] for b in bufs))
+        if global_key is not None:
+            _GLOBAL_PROGRAMS.put(global_key, (vstep, shapes))
+
+    SCAN_STATS.scan_passes += 1
+    SCAN_STATS.rows_scanned += sum(t.num_rows for t in tables)
+
+    import time as _time
+
+    t_start = _time.time()
+    t_d = _time.time()
+    device_out = vstep(*bufs)
+    SCAN_STATS.dispatch_seconds += _time.time() - t_d
+
+    folders = []
+    for _ in range(K):
+        folder = _PartialFolder(ops)
+        folder.shapes = shapes
+        folders.append(folder)
+    deferred = DeferredGroupScan(device_out, folders, t_start)
+    if defer:
+        return deferred
+    return deferred.results()
 
 
 # -- out-of-core streaming scan ---------------------------------------------

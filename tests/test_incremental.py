@@ -175,3 +175,131 @@ def test_kll_incremental(initial, delta):
     assert sum(b.count for b in dist.buckets) == 7
     assert dist.buckets[0].low_value == 1.0
     assert dist.buckets[-1].high_value == 7.0
+
+
+def test_pipelined_stream_equals_serial():
+    """IncrementalAnalysisStream (window of in-flight scans) must produce
+    byte-identical metric chains to the strictly serial loop — the state
+    merges happen at drain time in submission order."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        Maximum,
+        Mean,
+        Size,
+        StandardDeviation,
+        Uniqueness,
+    )
+    from deequ_tpu.analyzers.incremental import IncrementalAnalysisStream
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(21)
+    n_batches, rows = 7, 5000
+    batches = []
+    for b in range(n_batches):
+        vals = rng.normal(50.0 + b, 5.0, rows)
+        mask = rng.random(rows) > 0.02
+        cat = rng.integers(0, 40, rows).astype(np.int32)
+        batches.append(
+            ColumnarTable([
+                Column("v", DType.FRACTIONAL, values=vals, mask=mask),
+                Column("c", DType.STRING, codes=cat,
+                       dictionary=np.array([f"x{i}" for i in range(40)],
+                                           dtype=object)),
+            ])
+        )
+    analyzers = [
+        Size(), Completeness("v"), Mean("v"), StandardDeviation("v"),
+        Maximum("v"), ApproxCountDistinct("c"), Uniqueness(("c",)),
+    ]
+
+    # serial reference chain
+    serial_states = InMemoryStateProvider()
+    serial = []
+    for b, batch in enumerate(batches):
+        ctx = AnalysisRunner.do_analysis_run(
+            batch, analyzers,
+            aggregate_with=serial_states, save_states_with=serial_states,
+        )
+        serial.append(ctx)
+
+    # pipelined chain (window 3: several scans in flight)
+    stream_states = InMemoryStateProvider()
+    stream = IncrementalAnalysisStream(
+        analyzers, aggregate_with=stream_states,
+        save_states_with=stream_states, window=3,
+    )
+    piped = {}
+    for b, batch in enumerate(batches):
+        for tag, ctx in stream.submit(batch, tag=b):
+            piped[tag] = ctx
+    for tag, ctx in stream.close():
+        piped[tag] = ctx
+
+    assert sorted(piped) == list(range(n_batches))
+    for b in range(n_batches):
+        for a in analyzers:
+            want = serial[b].metric_map[a].value.get()
+            got = piped[b].metric_map[a].value.get()
+            assert got == want, (b, a, got, want)
+
+
+def test_pipelined_stream_streaming_batches_and_mixed_schemas():
+    """The micro-batch fast path must fall back safely for workloads it
+    cannot take: streaming batch tables (cannot defer) and groups with
+    string columns — results still equal the serial loop."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Completeness, Mean, Size
+    from deequ_tpu.analyzers.incremental import IncrementalAnalysisStream
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.streaming import stream_table
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(4)
+    tables = []
+    for b in range(5):
+        vals = rng.normal(10.0 + b, 1.0, 3000)
+        cat = rng.integers(0, 6, 3000).astype(np.int32)
+        tables.append(
+            ColumnarTable([
+                Column("v", DType.FRACTIONAL, values=vals),
+                Column("s", DType.STRING, codes=cat,
+                       dictionary=np.array(list("abcdef"), dtype=object)),
+            ])
+        )
+    analyzers = [Size(), Mean("v"), Completeness("s")]
+
+    serial_states = InMemoryStateProvider()
+    serial = []
+    for t in tables:
+        serial.append(
+            AnalysisRunner.do_analysis_run(
+                stream_table(t, batch_rows=1000), analyzers,
+                aggregate_with=serial_states, save_states_with=serial_states,
+            )
+        )
+
+    stream_states = InMemoryStateProvider()
+    stream = IncrementalAnalysisStream(
+        analyzers, aggregate_with=stream_states,
+        save_states_with=stream_states, window=2,
+    )
+    piped = {}
+    for b, t in enumerate(tables):
+        for tag, ctx in stream.submit(stream_table(t, batch_rows=1000), tag=b):
+            piped[tag] = ctx
+    for tag, ctx in stream.close():
+        piped[tag] = ctx
+
+    for b in range(5):
+        for a in analyzers:
+            assert (
+                piped[b].metric_map[a].value.get()
+                == serial[b].metric_map[a].value.get()
+            ), (b, a)
