@@ -196,7 +196,7 @@ def config5_from_disk(n_batches: int, batch_rows: int, tmpdir: str = "/tmp"):
     )
 
 
-def config5(n_batches: int, batch_rows: int, pipelined: bool = True):
+def config5(n_batches: int, batch_rows: int, pipelined: bool = True, seed: int = 44):
     """Incremental state stream + anomaly detection over the repository
     (BASELINE config #5 shape, scaled). ``pipelined`` uses the round-4
     IncrementalAnalysisStream (several batches' scans in flight, drains
@@ -215,7 +215,7 @@ def config5(n_batches: int, batch_rows: int, pipelined: bool = True):
     analyzers = [Size(), Mean("v"), StandardDeviation("v")]
     repo = InMemoryMetricsRepository()
     states = InMemoryStateProvider()
-    rng = np.random.default_rng(44)
+    rng = np.random.default_rng(seed)
 
     # pre-generate batches: data generation is not part of the measured
     # incremental loop (batches "arrive")

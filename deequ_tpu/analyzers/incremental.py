@@ -143,21 +143,13 @@ class IncrementalAnalysisStream:
         )
         if same_scanning and entries[0].scanning and len(entries) > 1:
             first = entries[0]
-            ops = []
-            scannable = []
-            op_fail: dict = {}
-            for analyzer in first.scanning:
-                try:
-                    op = analyzer.scan_op(first.data)
-                    op.cache_key = analyzer
-                    ops.append(op)
-                    scannable.append(analyzer)
-                except Exception as e:  # noqa: BLE001
-                    op_fail[analyzer] = wrap_if_necessary(e)
+            ops, scannable, op_fail = AnalysisRunner._build_scan_ops(
+                first.data, first.scanning
+            )
             tables = [e.data for e in entries]
             if scannable and group_scannable(tables, ops, current_mesh()):
-                exec_ops, plan = AnalysisRunner._coalesce_scan_ops(ops)
                 try:
+                    exec_ops, plan = AnalysisRunner._coalesce_scan_ops(ops)
                     scan = run_scan_group(tables, exec_ops, defer=True)
                 except Exception as e:  # noqa: BLE001 — dispatch failure
                     # maps onto every scanning analyzer of every entry

@@ -280,6 +280,28 @@ class AnalysisRunner:
         return exec_ops, plan
 
     @staticmethod
+    def _build_scan_ops(data: ColumnarTable, analyzers):
+        """Per-analyzer ScanOp construction with failure isolation: a
+        malformed op (e.g. a bad where expression) fails only its analyzer.
+        Returns (ops, scannable, op_failures) — analyzers are hashable
+        value objects, so each op's cache_key is its analyzer, keying the
+        traced-program cache for repeated runs (scan_engine). Shared by
+        the serial path and the pipelined group path
+        (analyzers/incremental.py) so op policy cannot drift between them."""
+        ops = []
+        scannable = []
+        op_failures = {}
+        for analyzer in analyzers:
+            try:
+                op = analyzer.scan_op(data)
+                op.cache_key = analyzer
+                ops.append(op)
+                scannable.append(analyzer)
+            except Exception as e:  # noqa: BLE001
+                op_failures[analyzer] = wrap_if_necessary(e)
+        return ops, scannable, op_failures
+
+    @staticmethod
     def _dispatch_scanning_analyzers(
         data: ColumnarTable,
         analyzers: Sequence[ScanShareableAnalyzer],
@@ -291,22 +313,11 @@ class AnalysisRunner:
         ctx = AnalyzerContext.empty()
         if not analyzers:
             return ctx, [], [], None
-        # per-analyzer op construction errors (e.g. a malformed where
-        # expression) fail only that analyzer, not the whole scan
-        ops = []
-        scannable = []
-        for analyzer in analyzers:
-            try:
-                op = analyzer.scan_op(data)
-                # analyzers are hashable value objects: their identity keys
-                # the traced-program cache for repeated runs (scan_engine)
-                op.cache_key = analyzer
-                ops.append(op)
-                scannable.append(analyzer)
-            except Exception as e:  # noqa: BLE001
-                ctx.metric_map[analyzer] = analyzer.to_failure_metric(
-                    wrap_if_necessary(e)
-                )
+        ops, scannable, op_failures = AnalysisRunner._build_scan_ops(
+            data, analyzers
+        )
+        for analyzer, err in op_failures.items():
+            ctx.metric_map[analyzer] = analyzer.to_failure_metric(err)
         if not scannable:
             return ctx, [], [], None
         try:

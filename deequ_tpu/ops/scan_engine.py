@@ -846,10 +846,22 @@ class DeferredScan:
     flight (analyzers/incremental.py) so the per-fetch tunnel/PCIe latency
     amortizes across batches instead of serializing them."""
 
-    def __init__(self, folder: _PartialFolder, in_flight, t_start: float):
+    def __init__(
+        self,
+        folder: _PartialFolder,
+        in_flight,
+        t_start: float,
+        bill_from_start: bool = False,
+    ):
         self._folder = folder
         self._in_flight = in_flight
         self._t_start = t_start
+        # resolved-inline scans (run_scan defer=False) bill the whole
+        # pack+dispatch+drain wall as before; genuinely deferred scans
+        # bill only the BLOCKING drain segment — wall between dispatch
+        # and drain belongs to the caller, and with several scans in
+        # flight it would double-count
+        self._bill_from_start = bill_from_start
         self._done = False
         self._error: Optional[BaseException] = None
 
@@ -857,16 +869,17 @@ class DeferredScan:
         if not self._done:
             import time as _time
 
-            # deferred scans bill only the BLOCKING drain segment (the
-            # dispatch side is already in dispatch_seconds): wall between
-            # dispatch and drain belongs to the caller, and with several
-            # scans in flight it would double-count
-            t0 = _time.time()
-            for device_result in self._in_flight:
-                self._folder.drain(device_result)
+            t0 = self._t_start if self._bill_from_start else _time.time()
+            pending = self._in_flight
             self._in_flight = []
-            SCAN_STATS.scan_seconds += _time.time() - t0
             self._done = True
+            try:
+                for device_result in pending:
+                    self._folder.drain(device_result)
+            except Exception as e:  # noqa: BLE001 — a retry must not
+                # re-fold already-drained chunks into the accumulator
+                self._error = e
+            SCAN_STATS.scan_seconds += _time.time() - t0
         if self._error is not None:
             raise self._error
         return self._folder.merged
@@ -1049,7 +1062,7 @@ def run_scan(
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
                 folder.drain(in_flight.pop(0))
-    deferred = DeferredScan(folder, in_flight, t_start)
+    deferred = DeferredScan(folder, in_flight, t_start, bill_from_start=not defer)
     if defer:
         return deferred
     return deferred.result()
@@ -1064,10 +1077,9 @@ class DeferredGroupScan:
     reduced-pytree list per table, identical to K separate run_scan calls
     (same pure per-chunk function, vmapped)."""
 
-    def __init__(self, device_out, folders, t_start):
+    def __init__(self, device_out, folders):
         self._device_out = device_out
         self._folders = folders
-        self._t_start = t_start
         self._results: Optional[list] = None
 
     def results(self) -> list:
@@ -1104,6 +1116,17 @@ def group_scannable(tables, ops, mesh) -> bool:
         return False
     sig = [(n, first[n].dtype) for n in needed]
     n_rows = first.num_rows
+    # single-chunk guard: the serial path splits bigger batches into
+    # chunks and host-merges partials — a different reduction association
+    # the bit-exact contract forbids (also keeps the packed stack within
+    # the per-chunk memory budget)
+    first_cols = {n: first[n] for n in needed}
+    if n_rows > _auto_chunk_rows(first_cols):
+        return False
+    # identical per-batch packer layouts: a union layout would promote
+    # columns (pair -> wide, i32 -> wide, mask additions) for batches the
+    # serial path packs narrower, diverging at the ulp level
+    layout0 = None
     for t in tables:
         if getattr(t, "is_streaming", False) or t.num_rows == 0:
             return False
@@ -1114,6 +1137,11 @@ def group_scannable(tables, ops, mesh) -> bool:
         if [(n, t[n].dtype) for n in needed] != sig:
             return False
         if any(t[n].dtype == DType.STRING for n, _ in sig):
+            return False
+        layout = _ChunkPacker({n: t[n] for n in needed}, n_rows).layout()
+        if layout0 is None:
+            layout0 = layout
+        elif layout != layout0:
             return False
     return True
 
@@ -1133,26 +1161,23 @@ def run_scan_group(
     group_scannable()."""
     K = len(tables)
     needed = sorted({c for op in ops for c in op.columns})
-    max_rows = max(t.num_rows for t in tables)
-    chunk = max(1, max_rows)
+    # group_scannable() guarantees equal nonzero batch sizes — the group
+    # chunk IS the (shared) batch size, exactly the serial path's chunk
+    chunk = tables[0].num_rows
+    assert all(t.num_rows == chunk for t in tables), "unequal batch sizes"
 
-    # one packer layout for the whole group: start from the first batch
-    # and fold the same monotone upgrades the streaming scan uses
-    # (narrow -> wide, pair -> wide, unmasked -> masked)
+    # group_scannable() has validated that every batch packs with the
+    # SAME layout at the same chunk size, so the first batch's layout is
+    # the group's (no union/promotion: that would change the compute path
+    # vs the per-batch serial scans and break bit-exactness)
     first_cols = {name: tables[0][name] for name in needed}
-    union = _ChunkPacker(first_cols, chunk).layout()
-    for t in tables[1:]:
-        cols_t = {name: t[name] for name in needed}
-        upgraded = _layout_upgrades(union, cols_t)
-        if upgraded is not None:
-            union = upgraded
-    packer = _ChunkPacker(first_cols, chunk, layout=union)
+    packer = _ChunkPacker(first_cols, chunk)
 
     # stack per-table packed buffers along a leading K axis
     stacked = None
     for t in tables:
         cols = {name: t[name] for name in needed}
-        p = _ChunkPacker(cols, chunk, layout=union)
+        p = _ChunkPacker(cols, chunk, layout=packer.layout())
         args = p.pack(0, t.num_rows)
         SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
         if stacked is None:
@@ -1206,7 +1231,6 @@ def run_scan_group(
 
     import time as _time
 
-    t_start = _time.time()
     t_d = _time.time()
     device_out = vstep(*bufs)
     SCAN_STATS.dispatch_seconds += _time.time() - t_d
@@ -1216,7 +1240,7 @@ def run_scan_group(
         folder = _PartialFolder(ops)
         folder.shapes = shapes
         folders.append(folder)
-    deferred = DeferredGroupScan(device_out, folders, t_start)
+    deferred = DeferredGroupScan(device_out, folders)
     if defer:
         return deferred
     return deferred.results()

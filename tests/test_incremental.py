@@ -303,3 +303,133 @@ def test_pipelined_stream_streaming_batches_and_mixed_schemas():
                 piped[b].metric_map[a].value.get()
                 == serial[b].metric_map[a].value.get()
             ), (b, a)
+
+
+def test_pipelined_stream_outlier_batch_falls_back_bit_exact():
+    """A batch whose values exceed the f32-pair range would force a wide
+    layout; the group fast path must fall back (layouts differ) so every
+    batch's results stay bit-identical to the serial loop."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Mean, Size, StandardDeviation
+    from deequ_tpu.analyzers.incremental import IncrementalAnalysisStream
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(2)
+    batches = []
+    for b in range(4):
+        vals = rng.normal(1e7, 1.0, 2000)
+        if b == 2:
+            vals[7] = 1e39  # beyond PAIR_SAFE_MAX -> wide layout
+        batches.append(
+            ColumnarTable([Column("v", DType.FRACTIONAL, values=vals)])
+        )
+    analyzers = [Size(), Mean("v"), StandardDeviation("v")]
+    with use_mesh(None):
+        s1 = InMemoryStateProvider()
+        serial = [
+            AnalysisRunner.do_analysis_run(
+                b, analyzers, aggregate_with=s1, save_states_with=s1
+            )
+            for b in batches
+        ]
+        s2 = InMemoryStateProvider()
+        stream = IncrementalAnalysisStream(
+            analyzers, aggregate_with=s2, save_states_with=s2, window=4
+        )
+        piped = {}
+        for i, b in enumerate(batches):
+            for t, c in stream.submit(b, tag=i):
+                piped[t] = c
+        for t, c in stream.close():
+            piped[t] = c
+    for i in range(4):
+        for a in analyzers:
+            assert (
+                piped[i].metric_map[a].value.get()
+                == serial[i].metric_map[a].value.get()
+            ), (i, a)
+
+
+def test_group_scannable_rejects_multi_chunk_batches():
+    """Batches bigger than one serial chunk must not take the group path
+    (chunked host merges have a different reduction association)."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.scan_engine import MAX_CHUNK_ROWS, group_scannable
+    from deequ_tpu.analyzers import Mean
+
+    small = ColumnarTable(
+        [Column("v", DType.FRACTIONAL, values=np.ones(1000))]
+    )
+    op = Mean("v").scan_op(small)
+    assert group_scannable([small, small], [op], None)
+
+    class FakeBig:
+        is_streaming = False
+        num_rows = MAX_CHUNK_ROWS + 1
+        column_names = ["v"]
+
+        def __contains__(self, name):
+            return name == "v"
+
+        def __getitem__(self, name):
+            return small["v"]
+
+    assert not group_scannable([FakeBig(), FakeBig()], [op], None)
+
+
+def test_group_fast_path_engages_and_matches_serial():
+    """On a single device with equal-size numeric batches the micro-batch
+    group path must actually ENGAGE (few fused group passes instead of one
+    pass per batch) and produce results exactly equal to the serial loop.
+    (The rest of the suite runs under the 8-device mesh, where the group
+    path correctly stays off — this is the single-device coverage.)"""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Mean, Size, StandardDeviation
+    from deequ_tpu.analyzers.incremental import IncrementalAnalysisStream
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.states import InMemoryStateProvider
+
+    rng = np.random.default_rng(31)
+    batches = [
+        ColumnarTable(
+            [Column("v", DType.FRACTIONAL, values=rng.normal(1.0, 2.0, 4000))]
+        )
+        for _ in range(6)
+    ]
+    analyzers = [Size(), Mean("v"), StandardDeviation("v")]
+    with use_mesh(None):
+        s1 = InMemoryStateProvider()
+        serial = [
+            AnalysisRunner.do_analysis_run(
+                b, analyzers, aggregate_with=s1, save_states_with=s1
+            )
+            for b in batches
+        ]
+        s2 = InMemoryStateProvider()
+        stream = IncrementalAnalysisStream(
+            analyzers, aggregate_with=s2, save_states_with=s2, window=3
+        )
+        SCAN_STATS.reset()
+        piped = {}
+        for i, b in enumerate(batches):
+            for t, c in stream.submit(b, tag=i):
+                piped[t] = c
+        for t, c in stream.close():
+            piped[t] = c
+        # 6 batches / window 3 = 2 fused group passes, NOT 6 per-batch ones
+        assert SCAN_STATS.scan_passes == 2, SCAN_STATS.scan_passes
+    for i in range(6):
+        for a in analyzers:
+            got = piped[i].metric_map[a].value.get()
+            want = serial[i].metric_map[a].value.get()
+            assert got == want, (i, a, got, want)
