@@ -147,10 +147,17 @@ class IncrementalAnalysisStream:
                 first.data, first.scanning
             )
             tables = [e.data for e in entries]
-            if scannable and group_scannable(tables, ops, current_mesh()):
+            shared_layout = (
+                group_scannable(tables, ops, current_mesh())
+                if scannable
+                else False
+            )
+            if shared_layout:
                 try:
                     exec_ops, plan = AnalysisRunner._coalesce_scan_ops(ops)
-                    scan = run_scan_group(tables, exec_ops, defer=True)
+                    scan = run_scan_group(
+                        tables, exec_ops, defer=True, layout=shared_layout
+                    )
                 except Exception as e:  # noqa: BLE001 — dispatch failure
                     # maps onto every scanning analyzer of every entry
                     wrapped = wrap_if_necessary(e)

@@ -231,9 +231,8 @@ def registers_from_hashes(hashes, valid, p: int, xp):
 
     idx = top p bits, rank = clz(remaining bits) + 1; registers take the max
     rank per idx. Invalid rows contribute rank 0. Lowering paths: one-hot
-    bf16 matmul on the MXU (default for large device chunks), XLA
-    segment_max (small chunks / host numpy), or the Pallas compare-select
-    kernel (ops/pallas_kernels.py, DEEQU_TPU_PALLAS=1).
+    bf16 matmul on the MXU (default for large device chunks) or XLA
+    segment_max (small chunks / host numpy).
     """
     import jax
 
@@ -246,18 +245,15 @@ def registers_from_hashes(hashes, valid, p: int, xp):
     idx = xp.where(valid, idx, 0)
 
     if xp is not np:
-        from deequ_tpu.ops import pallas_kernels
-
-        # NOTE: native TPU lowering is blocked in this environment — the
-        # tunnel's compile helper crashes on ANY Pallas grid-accumulation
-        # kernel (verified with a minimal repro; see ops/pallas_kernels.py
-        # docstring) — so the Pallas path currently runs interpret-mode only
-        if pallas_kernels.pallas_enabled() and jax.devices()[0].platform == "cpu":
-            return pallas_kernels.hll_fold(
-                idx, rank, num_registers=m, interpret=True
-            )
         # TPU only: on CPU backends the one-hot matmul is a large
-        # memory/FLOP regression over scatter (no MXU to ride)
+        # memory/FLOP regression over scatter (no MXU to ride).
+        # A Pallas compare-select fold was prototyped in round 1-3 and
+        # REMOVED in round 4: this environment's tunnel compiler SIGABRTs
+        # on any grid-accumulation Pallas kernel (minimal repro: a 2-step
+        # grid maximum over (8,128) i32 tiles with pl.when init), so it
+        # only ever ran interpret-mode, and the MXU matmul formulation
+        # below measured faster than the scatter it replaced anyway
+        # (~90ms vs ~197ms standalone for 10M rows; BENCHMARKS.md).
         if (
             idx.shape[0] >= _MXU_FOLD_MIN_ROWS
             and jax.devices()[0].platform != "cpu"

@@ -876,8 +876,10 @@ class DeferredScan:
             try:
                 for device_result in pending:
                     self._folder.drain(device_result)
-            except Exception as e:  # noqa: BLE001 — a retry must not
-                # re-fold already-drained chunks into the accumulator
+            except BaseException as e:  # noqa: BLE001 — a retry must not
+                # re-fold already-drained chunks into the accumulator, and
+                # even a KeyboardInterrupt mid-drain must leave the scan
+                # FAILED (raised again below), never silently half-folded
                 self._error = e
             SCAN_STATS.scan_seconds += _time.time() - t0
         if self._error is not None:
@@ -1097,10 +1099,11 @@ class DeferredGroupScan:
         return self._results
 
 
-def group_scannable(tables, ops, mesh) -> bool:
-    """True when run_scan_group supports this workload: single-device,
-    EQUAL-SIZE batches whose NEEDED columns are numeric and share one
-    schema, ops without dictionary LUTs (per-batch dictionaries would
+def group_scannable(tables, ops, mesh):
+    """The shared packer layout (truthy) when run_scan_group supports
+    this workload, else False: single-device, EQUAL-SIZE batches whose
+    NEEDED columns are numeric and share one schema AND one packer
+    layout, ops without dictionary LUTs (per-batch dictionaries would
     need per-batch lut arguments). Equal sizes keep the group path
     bit-identical to per-batch scans: padding a batch to a larger chunk
     changes the f32-pair reduction association at the ulp level, which
@@ -1143,13 +1146,17 @@ def group_scannable(tables, ops, mesh) -> bool:
             layout0 = layout
         elif layout != layout0:
             return False
-    return True
+    # the validated shared layout is the return value (truthy) so
+    # run_scan_group consumes the SAME derivation it was admitted under
+    # instead of re-deriving it
+    return layout0
 
 
 def run_scan_group(
     tables: Sequence[ColumnarTable],
     ops: Sequence[ScanOp],
     defer: bool = True,
+    layout: Optional[dict] = None,
 ):
     """One fused pass over K same-schema batches: pack each into the same
     single-chunk layout, stack to (K, ...) buffers, run ONE vmapped jitted
@@ -1164,14 +1171,15 @@ def run_scan_group(
     # group_scannable() guarantees equal nonzero batch sizes — the group
     # chunk IS the (shared) batch size, exactly the serial path's chunk
     chunk = tables[0].num_rows
-    assert all(t.num_rows == chunk for t in tables), "unequal batch sizes"
 
     # group_scannable() has validated that every batch packs with the
-    # SAME layout at the same chunk size, so the first batch's layout is
-    # the group's (no union/promotion: that would change the compute path
-    # vs the per-batch serial scans and break bit-exactness)
+    # SAME layout at the same chunk size (no union/promotion: that would
+    # change the compute path vs the per-batch serial scans and break
+    # bit-exactness); callers pass that validated layout through
     first_cols = {name: tables[0][name] for name in needed}
-    packer = _ChunkPacker(first_cols, chunk)
+    if layout is None:
+        layout = _ChunkPacker(first_cols, chunk).layout()
+    packer = _ChunkPacker(first_cols, chunk, layout=layout)
 
     # stack per-table packed buffers along a leading K axis
     stacked = None
