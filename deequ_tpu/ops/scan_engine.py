@@ -879,8 +879,11 @@ class DeferredScan:
             except BaseException as e:  # noqa: BLE001 — a retry must not
                 # re-fold already-drained chunks into the accumulator, and
                 # even a KeyboardInterrupt mid-drain must leave the scan
-                # FAILED (raised again below), never silently half-folded
+                # FAILED, never silently half-folded. Non-Exception
+                # control-flow signals (Ctrl-C) propagate immediately.
                 self._error = e
+                if not isinstance(e, Exception):
+                    raise
             SCAN_STATS.scan_seconds += _time.time() - t0
         if self._error is not None:
             raise self._error
@@ -919,16 +922,20 @@ def fetch_deferred(scans: Sequence["DeferredScan"]) -> None:
     i = 0
     for s in pending:
         n_parts = len(s._in_flight)
+        s._in_flight = []
+        s._done = True
         try:
             for k in range(n_parts):
                 s._folder.drain(parts[i + k])
-        except Exception as e:  # noqa: BLE001 — isolate per scan: a bad
-            # fold (e.g. a KLL compaction error) fails ITS scan's
-            # analyzers at result(), not the whole drained group
+        except BaseException as e:  # noqa: BLE001 — isolate per scan (a
+            # bad fold fails ITS analyzers at result()) AND keep the
+            # half-folded-accumulator invariant: even a KeyboardInterrupt
+            # mid-drain leaves the scan marked failed, never retryable.
+            # Non-Exception control-flow signals propagate immediately.
             s._error = e
+            if not isinstance(e, Exception):
+                raise
         i += n_parts
-        s._in_flight = []
-        s._done = True
     SCAN_STATS.scan_seconds += _time.time() - t0
 
 
@@ -1171,6 +1178,11 @@ def run_scan_group(
     # group_scannable() guarantees equal nonzero batch sizes — the group
     # chunk IS the (shared) batch size, exactly the serial path's chunk
     chunk = tables[0].num_rows
+    if any(t.num_rows != chunk for t in tables):
+        raise ValueError(
+            "run_scan_group requires equal-size batches "
+            "(check group_scannable() first)"
+        )
 
     # group_scannable() has validated that every batch packs with the
     # SAME layout at the same chunk size (no union/promotion: that would
