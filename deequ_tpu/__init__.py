@@ -66,6 +66,7 @@ from deequ_tpu.analyzers.incremental import (  # noqa: E402
 )
 from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
 from deequ_tpu.verification import (  # noqa: E402
+    IncrementalVerificationStream,
     VerificationResult,
     VerificationSuite,
 )

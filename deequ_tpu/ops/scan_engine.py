@@ -1090,19 +1090,33 @@ class DeferredGroupScan:
         self._device_out = device_out
         self._folders = folders
         self._results: Optional[list] = None
+        self._done = False
+        self._error: Optional[BaseException] = None
 
     def results(self) -> list:
-        if self._results is None:
+        if not self._done:
             import time as _time
 
+            # same half-folded-accumulator invariant as DeferredScan /
+            # fetch_deferred: mark done BEFORE draining so a mid-drain
+            # failure (or Ctrl-C) can never be retried into double-folds
+            self._done = True
             t0 = _time.time()
-            host = np.asarray(self._device_out)  # the one round trip
-            out = []
-            for k, folder in enumerate(self._folders):
-                folder.drain(host[k])
-                out.append(folder.merged)
-            SCAN_STATS.scan_seconds += _time.time() - t0
-            self._results = out
+            try:
+                host = np.asarray(self._device_out)  # the one round trip
+                out = []
+                for k, folder in enumerate(self._folders):
+                    folder.drain(host[k])
+                    out.append(folder.merged)
+                self._results = out
+            except BaseException as e:  # noqa: BLE001
+                self._error = e
+                if not isinstance(e, Exception):
+                    raise
+            finally:
+                SCAN_STATS.scan_seconds += _time.time() - t0
+        if self._error is not None:
+            raise self._error
         return self._results
 
 

@@ -68,6 +68,29 @@ class VerificationResult:
         return json.dumps(VerificationResult.check_results_as_rows(result))
 
 
+def _dedup_analyzers(analyzers: Sequence[Analyzer]) -> List[Analyzer]:
+    """Order-preserving de-dup (reference unions into a Set)."""
+    seen = set()
+    unique = []
+    for a in analyzers:
+        if a not in seen:
+            seen.add(a)
+            unique.append(a)
+    return unique
+
+
+def _save_or_append(metrics_repository, result_key, ctx: AnalyzerContext) -> None:
+    """Append ctx's metrics into the repository entry for result_key
+    (reference saveOrAppendResult, VerificationSuite.scala:174-193)."""
+    from deequ_tpu.repository import AnalysisResult
+
+    existing = metrics_repository.load_by_key(result_key)
+    combined = (
+        (existing.analyzer_context + ctx) if existing is not None else ctx
+    )
+    metrics_repository.save(AnalysisResult(result_key, combined))
+
+
 class VerificationSuite:
     """(reference VerificationSuite.scala:49-315)"""
 
@@ -101,13 +124,7 @@ class VerificationSuite:
         analyzers = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
-        # de-dup preserving order (reference unions into a Set)
-        seen = set()
-        unique_analyzers = []
-        for a in analyzers:
-            if a not in seen:
-                seen.add(a)
-                unique_analyzers.append(a)
+        unique_analyzers = _dedup_analyzers(analyzers)
 
         analysis_context = AnalysisRunner.do_analysis_run(
             data,
@@ -126,16 +143,9 @@ class VerificationSuite:
         result = VerificationSuite._evaluate(checks, analysis_context)
 
         if metrics_repository is not None and save_or_append_results_with_key is not None:
-            from deequ_tpu.repository import AnalysisResult
-
-            existing = metrics_repository.load_by_key(save_or_append_results_with_key)
-            combined = (
-                (existing.analyzer_context + analysis_context)
-                if existing is not None
-                else analysis_context
-            )
-            metrics_repository.save(
-                AnalysisResult(save_or_append_results_with_key, combined)
+            _save_or_append(
+                metrics_repository, save_or_append_results_with_key,
+                analysis_context,
             )
 
         VerificationSuite._save_json_outputs(
@@ -161,12 +171,7 @@ class VerificationSuite:
         analyzers = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
-        seen = set()
-        unique_analyzers = []
-        for a in analyzers:
-            if a not in seen:
-                seen.add(a)
-                unique_analyzers.append(a)
+        unique_analyzers = _dedup_analyzers(analyzers)
         ctx = AnalysisRunner.run_on_aggregated_states(
             schema,
             unique_analyzers,
@@ -226,6 +231,77 @@ class VerificationSuite:
                 continue
             with open(path, "w") as f:
                 f.write(payload())
+
+
+class IncrementalVerificationStream:
+    """Pipelined incremental VERIFICATION — the flagship incremental
+    monitoring loop (reference VerificationSuite.scala:208-229: per
+    arriving batch, merge states, evaluate checks, append results),
+    overlapped via the micro-batched scan pipeline
+    (analyzers/incremental.py:IncrementalAnalysisStream).
+
+    Check evaluation, repository appends, and anomaly-check assertions
+    happen at drain time in strict submission order — an
+    ``is_newest_point_non_anomalous`` check sees exactly the history a
+    serial loop would (each batch's result is appended AFTER its own
+    evaluation), so anomaly-gated monitoring works pipelined.
+
+    Usage::
+
+        stream = IncrementalVerificationStream(
+            checks=[check], aggregate_with=states,
+            save_states_with=states, metrics_repository=repo,
+        )
+        for key, batch in arriving:
+            for done_key, result in stream.submit(batch, result_key=key):
+                ...
+        for done_key, result in stream.close():
+            ...
+    """
+
+    def __init__(
+        self,
+        checks: Sequence[Check],
+        required_analyzers: Sequence[Analyzer] = (),
+        aggregate_with=None,
+        save_states_with=None,
+        metrics_repository=None,
+        window: int = 8,
+    ):
+        from deequ_tpu.analyzers.incremental import IncrementalAnalysisStream
+
+        self.checks = list(checks)
+        analyzers = list(required_analyzers)
+        for check in self.checks:
+            analyzers.extend(check.required_analyzers())
+        unique = _dedup_analyzers(analyzers)
+        self.metrics_repository = metrics_repository
+        self._stream = IncrementalAnalysisStream(
+            unique,
+            aggregate_with=aggregate_with,
+            save_states_with=save_states_with,
+            window=window,
+        )
+
+    def _finalize(self, drained):
+        out = []
+        for result_key, ctx in drained:
+            # evaluate BEFORE appending (anomaly constraints must not see
+            # their own run in the history — reference ordering)
+            result = VerificationSuite._evaluate(self.checks, ctx)
+            if self.metrics_repository is not None and result_key is not None:
+                _save_or_append(self.metrics_repository, result_key, ctx)
+            out.append((result_key, result))
+        return out
+
+    def submit(self, data: ColumnarTable, result_key=None):
+        """Dispatch one batch; returns finalized (result_key,
+        VerificationResult) pairs for batches drained now."""
+        return self._finalize(self._stream.submit(data, tag=result_key))
+
+    def close(self):
+        """Drain everything still in flight (FIFO)."""
+        return self._finalize(self._stream.close())
 
 
 @dataclass(frozen=True)

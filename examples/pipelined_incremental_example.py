@@ -1,0 +1,72 @@
+"""Pipelined incremental monitoring — the round-4 overlap of deequ's
+signature workflow (reference examples/IncrementalMetricsExample.scala +
+VerificationSuite.scala:208-229, but with several batches' device scans
+in flight at once).
+
+Each arriving batch is verified against cumulative dataset-level metrics
+(state chain via aggregate_with/save_states_with), its results append to
+the repository, and a Size anomaly check guards against volume jumps —
+all evaluated in strict arrival order while the scans themselves overlap.
+"""
+
+import numpy as np
+
+from deequ_tpu import Check, CheckLevel, IncrementalVerificationStream
+from deequ_tpu.analyzers import Size
+from deequ_tpu.anomaly import AbsoluteChangeStrategy
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.repository import ResultKey
+from deequ_tpu.repository.memory import InMemoryMetricsRepository
+from deequ_tpu.states import InMemoryStateProvider
+
+
+def run():
+    rng = np.random.default_rng(0)
+    repository = InMemoryMetricsRepository()
+    states = InMemoryStateProvider()
+
+    check = (
+        Check(CheckLevel.WARNING, "daily batch quality")
+        .has_completeness("amount", lambda c: c > 0.95)
+        .is_newest_point_non_anomalous(
+            repository, AbsoluteChangeStrategy(max_rate_increase=30_000.0),
+            Size(), {}, None, None,
+        )
+    )
+
+    stream = IncrementalVerificationStream(
+        checks=[check],
+        aggregate_with=states,
+        save_states_with=states,
+        metrics_repository=repository,
+        window=4,
+    )
+
+    def arriving_batches():
+        for day in range(10):
+            n = 20_000 if day != 7 else 80_000  # day 7: suspicious volume jump
+            vals = rng.normal(50.0, 10.0, n)
+            mask = rng.random(n) > 0.01
+            yield day, ColumnarTable(
+                [Column("amount", DType.FRACTIONAL, values=vals, mask=mask)]
+            )
+
+    finished = []
+    for day, batch in arriving_batches():
+        finished.extend(stream.submit(batch, result_key=ResultKey(day, {})))
+    finished.extend(stream.close())
+
+    for key, result in finished:
+        print(f"day {key.data_set_date}: {result.status}")
+    statuses = {key.data_set_date: str(result.status) for key, result in finished}
+    # day 0 warns by design: the anomaly detector requires non-empty
+    # history (reference AnomalyDetector.scala:39-65), so the very first
+    # batch's anomaly constraint fails — monitoring starts on day 1
+    assert "WARNING" in statuses[7].upper(), statuses  # the jump is flagged
+    assert all("SUCCESS" in statuses[d].upper() for d in range(1, 7)), statuses
+    print("pipelined incremental monitoring flagged the day-7 volume jump")
+    return statuses
+
+
+if __name__ == "__main__":
+    run()

@@ -522,3 +522,25 @@ def test_stream_csv_bool_mixed_literal_parity(tmp_path):
         sctx.metric_map[Completeness("b")].value.get()
         == bctx.metric_map[Completeness("b")].value.get()
     )
+
+
+def test_billion_row_proof_harness_scaled():
+    """The committed 1B-row proof harness (benchmarks/BILLION_ROW_PROOF.md)
+    must keep passing at a scaled size: segmented incremental == one-pass
+    streaming, RSS bound asserted internally."""
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "benchmarks"))
+    import billion_row_proof
+
+    argv = sys.argv
+    try:
+        sys.argv = [
+            "p", "--rows", "8000000", "--segments", "4",
+            "--batch-rows", "1000000",
+        ]
+        billion_row_proof.main()
+    finally:
+        sys.argv = argv

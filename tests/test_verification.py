@@ -139,3 +139,84 @@ def test_multiple_checks_share_one_scan(table):
     )
     VerificationSuite.on_data(table).add_check(c1).add_check(c2).run()
     assert SCAN_STATS.scan_passes == 1
+
+
+def test_incremental_verification_stream_equals_serial_with_anomaly_check():
+    """IncrementalVerificationStream must produce the same check statuses,
+    metric values, and repository contents as the serial per-batch
+    VerificationSuite loop — including an anomaly check whose assertion
+    queries the repository history (order-sensitive: each batch's result
+    appends AFTER its own evaluation)."""
+    import numpy as np
+
+    from deequ_tpu import (
+        Check,
+        CheckLevel,
+        IncrementalVerificationStream,
+        VerificationSuite,
+    )
+    from deequ_tpu.anomaly import AbsoluteChangeStrategy
+    from deequ_tpu.analyzers import Size
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.repository import ResultKey
+    from deequ_tpu.repository.memory import InMemoryMetricsRepository
+
+    rng = np.random.default_rng(6)
+    n_batches = 6
+    # batch 4 doubles in size -> the Size anomaly check must flag it
+    sizes = [3000, 3000, 3000, 3000, 6000, 3000]
+    batches = [
+        ColumnarTable(
+            [Column("v", DType.FRACTIONAL, values=rng.normal(1.0, 1.0, s))]
+        )
+        for s in sizes
+    ]
+
+    def make_check(repo):
+        return (
+            Check(CheckLevel.WARNING, "size anomaly")
+            .is_newest_point_non_anomalous(
+                repo, AbsoluteChangeStrategy(max_rate_increase=1000.0),
+                Size(), {}, None, None,
+            )
+            .has_completeness("v", lambda c: c == 1.0)
+        )
+
+    # serial reference
+    repo_s = InMemoryMetricsRepository()
+    serial_results = []
+    for b, batch in enumerate(batches):
+        res = VerificationSuite.do_verification_run(
+            batch, [make_check(repo_s)],
+            save_or_append_results_with_key=ResultKey(b, {"s": "x"}),
+            metrics_repository=repo_s,
+        )
+        serial_results.append(res)
+
+    # pipelined
+    repo_p = InMemoryMetricsRepository()
+    stream = IncrementalVerificationStream(
+        checks=[make_check(repo_p)],
+        metrics_repository=repo_p,
+        window=3,
+    )
+    piped = {}
+    for b, batch in enumerate(batches):
+        for key, res in stream.submit(batch, result_key=ResultKey(b, {"s": "x"})):
+            piped[key.data_set_date] = res
+    for key, res in stream.close():
+        piped[key.data_set_date] = res
+
+    assert sorted(piped) == list(range(n_batches))
+    statuses_serial = [str(r.status) for r in serial_results]
+    statuses_piped = [str(piped[b].status) for b in range(n_batches)]
+    assert statuses_piped == statuses_serial
+    # the doubled batch must be flagged in both
+    assert "Warning" in statuses_serial[4] or "WARNING" in statuses_serial[4].upper()
+    # repositories hold identical metric values
+    for b in range(n_batches):
+        ms = repo_s.load_by_key(ResultKey(b, {"s": "x"})).analyzer_context
+        mp = repo_p.load_by_key(ResultKey(b, {"s": "x"})).analyzer_context
+        assert {str(a): m.value.get() for a, m in ms.metric_map.items()} == {
+            str(a): m.value.get() for a, m in mp.metric_map.items()
+        }
