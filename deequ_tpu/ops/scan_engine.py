@@ -142,6 +142,9 @@ class ScanStats:
         self.programs_built = 0
         self.programs_reused = 0
         self.device_sort_passes = 0
+        # device->host result bytes (grouping paths): the sparse group-by
+        # contract is fetched bytes ~ O(k*G), never O(k*n)
+        self.bytes_fetched = 0
         # time spent issuing step dispatches (host-side enqueue; near zero
         # unless the runtime backpressures) vs time blocked waiting for
         # device results in drain. drain_wait ~= device compute + any

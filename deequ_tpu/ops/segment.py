@@ -30,6 +30,25 @@ from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh
 # dense device count vectors are used up to this key-space size
 DENSE_KEYSPACE_LIMIT = 1 << 22
 
+# below this row count the single-phase fetch (O(n) bytes) is cheaper than
+# the extra device round trip the two-phase O(G) fetch path pays
+SMALL_N_FETCH_LIMIT = 1 << 16
+
+
+def _pad_group_count(g: int) -> int:
+    """Static gather size for a data-dependent group count: next power of
+    two (>= 64) so jit programs are shared across nearby G and the fetched
+    bytes stay within 2x of the exact O(G) bound."""
+    size = 64
+    while size < g:
+        size <<= 1
+    return size
+
+
+def _record_fetch(*arrays) -> None:
+    for a in arrays:
+        SCAN_STATS.bytes_fetched += int(a.size) * a.itemsize
+
 
 @jax.jit
 def _unique_inverse_kernel(v, m):
@@ -47,7 +66,13 @@ def _unique_inverse_kernel(v, m):
     ids = jnp.cumsum(starts.astype(jnp.int64))
     codes_sorted = jnp.where(sm, ids, 0)
     inv = jnp.zeros_like(ids).at[perm].set(codes_sorted)
-    return sv, starts, inv
+    return sv, starts, inv, ids[-1]  # ids[-1] == number of distinct values
+
+
+@partial(jax.jit, static_argnames=("size",))
+def _gather_at_starts_kernel(sv, starts, size):
+    positions = jnp.nonzero(starts, size=size, fill_value=0)[0]
+    return sv[positions]
 
 
 def _device_unique_inverse(
@@ -56,11 +81,16 @@ def _device_unique_inverse(
     """Sort-based unique on DEVICE (the shuffle-sort of SURVEY §2.14.2):
     one lexsort puts valid values in order, adjacent-compare marks group
     starts, a cumsum assigns dense ids, and a scatter maps them back to row
-    order. Host work is only the O(n) fetch + boolean compress — no host
-    sort. NaN values (possible when a caller builds columns with explicit
+    order. NaN values (possible when a caller builds columns with explicit
     masks) collapse into ONE distinct group, matching np.unique's
     equal_nan semantics. Returns (uniques, codes) with codes 0 = null,
-    1..K = distinct."""
+    1..K = distinct.
+
+    Fetch discipline: the row codes (O(n)) must come to host — they feed
+    the host-side key packing — but the distinct values are gathered at
+    group starts ON DEVICE so only O(U) values are fetched (plus one
+    scalar round trip for U), not the full sorted column. Small inputs
+    keep the single-phase fetch (the extra round trip would dominate)."""
     n = len(values)
     if n == 0:
         return np.empty(0, dtype=values.dtype), np.zeros(0, dtype=np.int64)
@@ -68,14 +98,26 @@ def _device_unique_inverse(
     if values.dtype != np.float64:
         # integer/bool columns have no NaN; the kernel's v != v is all-False
         values = np.asarray(values)
-    sv, starts, inv = (
-        np.asarray(x) for x in _unique_inverse_kernel(values, mask)
-    )
-    return sv[starts], inv
+    sv_dev, starts_dev, inv_dev, nu_dev = _unique_inverse_kernel(values, mask)
+    if n <= SMALL_N_FETCH_LIMIT:
+        sv, starts, inv = (
+            np.asarray(x) for x in (sv_dev, starts_dev, inv_dev)
+        )
+        _record_fetch(sv, starts, inv)
+        return sv[starts], inv
+    num_uniques = int(nu_dev)
+    SCAN_STATS.bytes_fetched += 8
+    size = _pad_group_count(num_uniques)
+    uniques = np.asarray(_gather_at_starts_kernel(sv_dev, starts_dev, size))
+    inv = np.asarray(inv_dev)
+    _record_fetch(uniques, inv)
+    return uniques[:num_uniques], inv
 
 
-@jax.jit
-def _matrix_rle_kernel(mat, va):
+def _sorted_starts(mat, va):
+    """Traced helper shared by every sparse-grouping kernel: lexsort the
+    (k, n) code matrix with valid rows first, mark run starts among valid
+    rows. Returns (sorted matrix, sorted validity, starts)."""
     perm = jnp.lexsort(tuple(mat) + (~va,))  # valid rows first
     smat = mat[:, perm]
     sva = va[perm]
@@ -84,26 +126,84 @@ def _matrix_rle_kernel(mat, va):
     return smat, sva, starts
 
 
+def _run_lengths(positions, n, m):
+    """Traced helper: run lengths from ascending start positions (padded
+    slots hold ``n``); valid rows occupy the sorted prefix [0, m). Padded
+    slots produce count 0."""
+    nxt = jnp.minimum(
+        jnp.concatenate(
+            [positions[1:], jnp.full((1,), n, dtype=positions.dtype)]
+        ),
+        m,
+    )
+    return jnp.maximum(nxt - jnp.minimum(positions, m), 0)
+
+
+@jax.jit
+def _matrix_rle_kernel(mat, va):
+    smat, sva, starts = _sorted_starts(mat, va)
+    # scalars ride back in ONE fetch: [num_groups, num_valid]
+    scalars = jnp.stack(
+        [jnp.sum(starts.astype(jnp.int64)), jnp.sum(sva.astype(jnp.int64))]
+    )
+    return smat, sva, starts, scalars
+
+
+@partial(jax.jit, static_argnames=("size",))
+def _rle_gather_kernel(smat, starts, m, size):
+    """Gather group representatives + run lengths for the first ``size``
+    group starts, entirely on device. Padded slots (beyond the true group
+    count) gather index 0 and produce count 0 — the host filters them."""
+    n = smat.shape[1]
+    positions = jnp.nonzero(starts, size=size, fill_value=n)[0]
+    counts = _run_lengths(positions, n, m)
+    reps = smat[:, jnp.minimum(positions, n - 1)]
+    return reps, counts
+
+
 def _device_matrix_rle(
     code_matrix: np.ndarray, valid: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Run-length-encode the distinct rows of a (k, n) code matrix via one
     device lexsort + adjacent-compare (the sparse/high-cardinality group-by;
     replaces a host np.unique(axis=0) which is a full host sort). Returns
-    (groups (k, G), counts (G,)) for valid rows."""
+    (groups (k, G), counts (G,)) for valid rows.
+
+    Device-bounded fetch: the sorted (k, n) matrix never leaves the device.
+    One scalar round trip reads the group count G, then a second kernel
+    gathers the (k, G) representatives + (G,) run lengths on device, so
+    fetched bytes are O(k*G) — not O(k*n) — matching the reference's
+    shuffle group-by output size (GroupingAnalyzers.scala:66-78). Small
+    inputs keep the single-phase fetch."""
     k, n = code_matrix.shape
     if n == 0:
         return code_matrix[:, :0], np.zeros(0, dtype=np.int64)
     SCAN_STATS.device_sort_passes += 1
 
-    smat, sva, starts = (
-        np.asarray(x) for x in _matrix_rle_kernel(code_matrix, valid)
+    smat_dev, sva_dev, starts_dev, scalars_dev = _matrix_rle_kernel(
+        code_matrix, valid
     )
-    m = int(sva.sum())  # valid rows occupy the sorted prefix
-    positions = np.nonzero(starts)[0]
-    groups = smat[:, positions]
-    counts = np.diff(np.append(positions, m)).astype(np.int64)
-    return groups, counts
+    if n <= SMALL_N_FETCH_LIMIT:
+        smat, sva, starts = (
+            np.asarray(x) for x in (smat_dev, sva_dev, starts_dev)
+        )
+        _record_fetch(smat, sva, starts)
+        m = int(sva.sum())  # valid rows occupy the sorted prefix
+        positions = np.nonzero(starts)[0]
+        groups = smat[:, positions]
+        counts = np.diff(np.append(positions, m)).astype(np.int64)
+        return groups, counts
+
+    num_groups, m = (int(x) for x in np.asarray(scalars_dev))
+    SCAN_STATS.bytes_fetched += 16
+    size = _pad_group_count(num_groups)
+    reps, counts = (
+        np.asarray(x)
+        for x in _rle_gather_kernel(smat_dev, starts_dev, m, size)
+    )
+    _record_fetch(reps, counts)
+    keep = counts > 0
+    return reps[:, keep], counts[keep].astype(np.int64)
 
 
 def column_key_codes(col: Column) -> Tuple[np.ndarray, List]:
@@ -263,6 +363,26 @@ def _topk_from_counts_fn(kk: int, merge_null_into: int = -1):
 
 
 @jax.jit
+def _rle_stats_kernel(mat, va):
+    """Sparse group-by count-distribution aggregates entirely on device:
+    lexsort + run starts as in _matrix_rle_kernel, then run lengths via a
+    positions-diff over a full-length (static-shape) sorted position
+    vector — no data-dependent shapes, so num_groups, singletons, and the
+    entropy numerator sum(c*log c) come back as FOUR SCALARS regardless of
+    how many distinct groups the data has."""
+    _smat, sva, starts = _sorted_starts(mat, va)
+    n = mat.shape[1]
+    m = jnp.sum(sva)  # valid rows occupy the sorted prefix
+    pos = jnp.sort(jnp.where(starts, jnp.arange(n, dtype=jnp.int64), n))
+    counts = _run_lengths(pos, n, m)
+    num_groups = jnp.sum(starts)
+    singletons = jnp.sum(counts == 1)
+    c = counts.astype(jnp.float64)
+    clogc = jnp.sum(jnp.where(counts > 0, c, 0.0) * jnp.log(jnp.where(counts > 0, c, 1.0)))
+    return m, num_groups, singletons, clogc
+
+
+@jax.jit
 def _stats_from_counts(counts):
     total = counts.sum()
     groups = (counts > 0).sum()
@@ -285,6 +405,7 @@ def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
         keys = np.concatenate([keys, np.full(padded - n, -1, dtype=np.int64)])
 
     counts = np.asarray(_bincount_fn(num_segments, mesh)(keys))
+    _record_fetch(counts)
     return counts[:num_segments]
 
 
@@ -487,6 +608,7 @@ def group_top_k(
     num_groups, top_counts, top_idx = (
         np.asarray(x) for x in _topk_fn(num_segments, kk, mesh, nv_code)(codes)
     )
+    _record_fetch(num_groups, top_counts, top_idx)
 
     top = []
     for idx, cnt in zip(top_idx.tolist(), top_counts.tolist()):
@@ -569,28 +691,33 @@ def group_count_stats(
             keys = np.where(any_non_null, keys, -1)
         counts = _device_bincount(keys, keyspace, mesh)
         counts = counts[counts > 0]
-    else:
-        matrix = np.stack(code_arrays, axis=0)
-        valid = (
-            any_non_null
-            if any_non_null is not None
-            else np.ones(table.num_rows, dtype=bool)
-        )
-        SCAN_STATS.device_sort_passes += 1
-        _smat, sva, starts = _matrix_rle_kernel(matrix, valid)
-        # fetch ONLY the boolean vectors — the sorted group matrix stays on
-        # device (it is only needed when materializing the full table)
-        sva = np.asarray(sva)
-        starts = np.asarray(starts)
-        m = int(sva.sum())
-        positions = np.nonzero(starts)[0]
-        counts = np.diff(np.append(positions, m)).astype(np.int64)
+        num_groups = int(len(counts))
+        singletons = int((counts == 1).sum())
+        if num_rows > 0 and num_groups > 0:
+            p = counts.astype(np.float64) / num_rows
+            entropy = float(-(p * np.log(p)).sum())
+        else:
+            entropy = float("nan")
+        return CountStats(num_rows, num_groups, singletons, entropy)
 
-    num_groups = int(len(counts))
-    singletons = int((counts == 1).sum())
+    # sparse path: every aggregate reduces ON DEVICE — only four scalars
+    # are fetched, regardless of group count (the former implementation
+    # fetched two n-length boolean vectors)
+    matrix = np.stack(code_arrays, axis=0)
+    valid = (
+        any_non_null
+        if any_non_null is not None
+        else np.ones(table.num_rows, dtype=bool)
+    )
+    SCAN_STATS.device_sort_passes += 1
+    m, num_groups, singletons, clogc = (
+        float(x) for x in _rle_stats_kernel(matrix, valid)
+    )
+    SCAN_STATS.bytes_fetched += 4 * 8
+    num_groups = int(num_groups)
     if num_rows > 0 and num_groups > 0:
-        p = counts.astype(np.float64) / num_rows
-        entropy = float(-(p * np.log(p)).sum())
+        # entropy = -sum (c/N) log(c/N) = log N - (sum c*log c)/N, N = m
+        entropy = float(np.log(m) - clogc / m)
     else:
         entropy = float("nan")
     return CountStats(num_rows, num_groups, singletons, entropy)
