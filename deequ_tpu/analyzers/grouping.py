@@ -91,6 +91,11 @@ def _column_from_cells(cells: list):
     return values, nulls
 
 
+# single NaN object shared by every canonicalized NaN key: dict lookup
+# succeeds via the identity fast path even though nan != nan
+_CANONICAL_NAN = float("nan")
+
+
 class FrequenciesAndNumRows(State):
     """Group frequencies + total row count (at least one grouping column
     non-null). Merge = add counts across the union of groups.
@@ -126,7 +131,19 @@ class FrequenciesAndNumRows(State):
     def from_dict(
         columns: Sequence[str], frequencies: Dict[tuple, int], num_rows: int
     ) -> "FrequenciesAndNumRows":
-        items = sorted(frequencies.items(), key=lambda kv: repr(kv[0]))
+        # distinct float('nan') objects are distinct dict keys; the
+        # columnar path collapses NaN keys into one group (np.unique
+        # equal_nan), so canonicalize here for one shared semantics
+        canon: Dict[tuple, int] = {}
+        for g, c in frequencies.items():
+            key = tuple(
+                _CANONICAL_NAN
+                if isinstance(x, float) and x != x
+                else x
+                for x in g
+            )
+            canon[key] = canon.get(key, 0) + c
+        items = sorted(canon.items(), key=lambda kv: repr(kv[0]))
         n_cols = len(tuple(columns))
         key_values = []
         key_nulls = []
@@ -167,7 +184,12 @@ class FrequenciesAndNumRows(State):
         nulls = self.key_nulls if nulls is None else nulls
         codes = []
         for v, nl in zip(arrays, nulls):
-            _, inv = np.unique(v, return_inverse=True)
+            if v.dtype.kind == "f":
+                # pin NaN-collapse semantics explicitly (numpy default
+                # since 1.24): one NaN group, matching the device path
+                _, inv = np.unique(v, return_inverse=True, equal_nan=True)
+            else:
+                _, inv = np.unique(v, return_inverse=True)
             codes.append(np.where(nl, 0, inv.reshape(v.shape) + 1))
         return codes
 
@@ -202,20 +224,26 @@ class FrequenciesAndNumRows(State):
                         f"group-key types ({a.dtype} vs {b.dtype}) for "
                         f"columns {self.columns}"
                     )
+                ka, kb = a.dtype.kind, b.dtype.kind  # adoption changed one
             # promote dtypes (e.g. two unicode widths, int64 vs float64 —
             # numeric promotion matches dict semantics, where 5 and 5.0
-            # hash to the same key). int -> float64 is only faithful below
-            # 2^53; beyond that distinct int keys would silently collapse
+            # hash to the same key). integer -> float64 is only faithful
+            # below 2^53; beyond that distinct keys would silently collapse.
+            # Fire whenever the PROMOTED dtype is float (covers uint64 vs
+            # int64, which numpy promotes to float64 too); compare min/max
+            # directly — np.abs(int64 min) wraps negative.
+            common = np.promote_types(a.dtype, b.dtype)
             for arr in (a, b):
-                if arr.dtype.kind == "i" and {ka, kb} == {"i", "f"} and len(
+                if arr.dtype.kind in "iu" and common.kind == "f" and len(
                     arr
-                ) and int(np.abs(arr).max()) > 2 ** 53:
+                ) and (
+                    int(arr.max()) > 2 ** 53 or int(arr.min()) < -(2 ** 53)
+                ):
                     raise ValueError(
-                        "cannot merge int group keys above 2^53 with a "
-                        "float-keyed state: float64 promotion would "
+                        "cannot merge integer group keys above 2^53 into a "
+                        "float64-promoted key space: promotion would "
                         "collapse distinct keys"
                     )
-            common = np.promote_types(a.dtype, b.dtype)
             cat_vals.append(
                 np.concatenate([a.astype(common), b.astype(common)])
             )
@@ -678,17 +706,33 @@ class Histogram(FrequencyBasedAnalyzer):
             if failing is not None:
                 return self.to_failure_metric(failing)
             try:
-                stats = group_top_k(table, self.column, self.max_detail_bins)
+                # fetch ONE extra entry: if a count tie straddles the
+                # truncation boundary, device top_k order (first-seen code)
+                # would pick a different bin set than the state path's
+                # stringified-key tie-break — fall back to the full path
+                # so both produce the same Distribution
+                stats = group_top_k(
+                    table, self.column, self.max_detail_bins + 1
+                )
             except Exception as e:  # noqa: BLE001
                 from deequ_tpu.exceptions import wrap_if_necessary
 
                 return self.to_failure_metric(wrap_if_necessary(e))
+            top = stats.top
+            if len(top) > self.max_detail_bins:
+                if top[self.max_detail_bins][1] == top[
+                    self.max_detail_bins - 1
+                ][1]:
+                    return super().calculate(
+                        table, aggregate_with, save_states_with
+                    )
+                top = top[: self.max_detail_bins]
 
             def build_fast() -> Distribution:
                 # merge stringified collisions (e.g. 1 vs "1" -> "1") the
                 # same way the full path does
                 merged: Dict[str, int] = {}
-                for value, count in stats.top:
+                for value, count in top:
                     key = _stringify(value)
                     merged[key] = merged.get(key, 0) + count
                 details = {
@@ -713,9 +757,27 @@ class Histogram(FrequencyBasedAnalyzer):
             # selected bins decode to python objects
             counts = state.counts
             k = min(self.max_detail_bins, len(counts))
-            order = np.argsort(-counts, kind="stable")[:k]
+            order = np.argsort(-counts, kind="stable")
             values = state.key_values[0]
             nulls = state.key_nulls[0]
+            if k < len(order) and counts[order[k]] == counts[order[k - 1]]:
+                # count ties straddle the truncation boundary: break them
+                # by stringified key so the selected bin set is stable
+                # across engine paths/versions (repository comparability);
+                # only the tied groups pay the python stringification
+                c_thr = counts[order[k - 1]]
+                above = order[counts[order] > c_thr]
+                ties = sorted(
+                    order[counts[order] == c_thr].tolist(),
+                    key=lambda g: str(
+                        _cell_to_python(values[g], bool(nulls[g]))
+                    ),
+                )
+                order = np.concatenate(
+                    [above, np.asarray(ties[: k - len(above)], dtype=order.dtype)]
+                )
+            else:
+                order = order[:k]
             details = {}
             for g in order.tolist():
                 cell = _cell_to_python(values[g], bool(nulls[g]))

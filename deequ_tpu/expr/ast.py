@@ -111,3 +111,29 @@ class FnCall(Expr):
     @property
     def _children(self):
         return tuple(self.args)
+
+
+_COMPARE_OPS = frozenset({"=", "==", "!=", "<>", "<", "<=", ">", ">="})
+
+
+def boundary_columns(expr: Expr) -> set:
+    """Columns whose values feed a comparison boundary (equality, ordered
+    compare, IN, BETWEEN). The two-float f32 pair transfer carries ~49
+    mantissa bits, so values routed through it can land ~1e-16 (relative)
+    off the original f64 — invisible to aggregates at the validated 1e-12
+    tolerance but able to flip an exact comparison like ``x == 0.1``. The
+    scan packer routes these columns over the exact wide-f64 plane
+    (scan_engine._packs_as_pair)."""
+    out: set = set()
+
+    def walk(e: Expr) -> None:
+        if isinstance(e, BinaryOp) and e.op in _COMPARE_OPS:
+            out.update(e.left.columns())
+            out.update(e.right.columns())
+        elif isinstance(e, (InList, Between)):
+            out.update(e.columns())
+        for child in getattr(e, "_children", ()):
+            walk(child)
+
+    walk(expr)
+    return out

@@ -109,10 +109,13 @@ class EvalContext:
             raise ExprEvalError(f"unknown column: {name}")
         v = self.columns[name]
         if v.kind == "num" and v.lo is not None:
-            # two-float pair column: the evaluator computes in exact f64
-            # semantics (predicates must match the reference's Spark SQL
-            # doubles bit-for-bit at comparison boundaries), so reconstruct
-            # hi + lo once per chunk and memoize on the context
+            # two-float pair column: reconstruct hi + lo once per chunk and
+            # memoize. The sum is EXACT in f64, but the pair itself carries
+            # only ~49 mantissa bits of the original value, so columns that
+            # feed comparison boundaries are routed onto the wide-f64 plane
+            # at pack time instead (_mark_exact_compare_columns) — a pair
+            # column only reaches a predicate through pinned/persisted
+            # layouts, which warn (scan_engine._warn_pair_compare_once)
             v = Val(
                 "num",
                 v.data.astype(self.xp.float64) + v.lo.astype(self.xp.float64),
@@ -500,6 +503,27 @@ def eval_predicate_on_table(src_or_expr, table: ColumnarTable) -> np.ndarray:
     return np.asarray(predicate_row_mask(val, np, table.num_rows))
 
 
+def _mark_exact_compare_columns(expr: Expr, table) -> None:
+    """Fractional columns referenced by a comparison boundary must transfer
+    on the exact wide-f64 plane, not the ~49-bit (hi, lo) f32 pair: pair
+    reconstruction is ~1e-16 relative off the original value, which flips
+    predicates like ``x == 0.1`` for rows that match exactly. Marking the
+    Column here (the single funnel every where/satisfies predicate compiles
+    through) makes scan_engine._packs_as_pair route it wide. Persisted /
+    stream-pinned layouts that already routed the column as a pair can't be
+    changed mid-flight — the packer warns there instead."""
+    from deequ_tpu.data.table import DType
+    from deequ_tpu.expr.ast import boundary_columns
+
+    try:
+        names = set(table.column_names)
+    except AttributeError:
+        return
+    for name in boundary_columns(expr):
+        if name in names and table[name].dtype == DType.FRACTIONAL:
+            table[name]._exact_compare = True
+
+
 def compile_predicate(src_or_expr, table: ColumnarTable):
     """Compile a predicate for device execution inside a fused scan.
 
@@ -514,6 +538,7 @@ def compile_predicate(src_or_expr, table: ColumnarTable):
 
     expr = src_or_expr if isinstance(src_or_expr, Expr) else parse_expression(src_or_expr)
     cols = expr.columns()
+    _mark_exact_compare_columns(expr, table)
 
     def fn(chunk_vals: Dict[str, Val], xp, n: int):
         ctx = EvalContext(xp, chunk_vals)

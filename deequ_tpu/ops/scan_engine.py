@@ -224,10 +224,14 @@ def _packs_as_i32(col: Column) -> bool:
 def _packs_as_pair(col: Column) -> bool:
     """Fractional columns whose finite values fit the (hi, lo) f32 pair
     representation (|x| <= f32_max) — the native-dtype compute path. The
-    range check is cached per Column like _packs_as_i32."""
+    range check is cached per Column like _packs_as_i32. Columns marked by
+    a comparison predicate (expr/eval._mark_exact_compare_columns) route
+    wide: predicate boundaries need the exact f64 value."""
     from deequ_tpu.ops.df32 import pair_safe_np
 
     if col.dtype != DType.FRACTIONAL:
+        return False
+    if getattr(col, "_exact_compare", False):
         return False
     cached = getattr(col, "_pair_safe", None)
     if cached is None:
@@ -253,6 +257,32 @@ def _compute_f64() -> bool:
     import os
 
     return os.environ.get("DEEQU_TPU_COMPUTE", "").lower() == "f64"
+
+
+_PAIR_COMPARE_WARNED: set = set()
+
+
+def _warn_pair_compare_once(name: str, col=None) -> None:
+    """A persisted/stream-pinned layout already routed this column over the
+    ~49-bit f32 pair, but a predicate now compares it at a boundary; the
+    layout can't change mid-flight, so comparisons may be ~1e-16 (relative)
+    off exact f64. Re-persist the table (or set DEEQU_TPU_COMPUTE=f64) for
+    exact predicate semantics. Deduped per Column OBJECT — a different
+    table reusing the same column name still gets its own warning."""
+    key = (id(col), name)
+    if key in _PAIR_COMPARE_WARNED:
+        return
+    _PAIR_COMPARE_WARNED.add(key)
+    import warnings
+
+    warnings.warn(
+        f"column {name!r} is compared at a predicate boundary but was "
+        "persisted/pinned on the two-float f32 plane (~49 mantissa bits); "
+        "exact-equality predicates may miss values within ~1e-16 relative. "
+        "Re-persist the table after declaring the check, or set "
+        "DEEQU_TPU_COMPUTE=f64.",
+        stacklevel=3,
+    )
 
 
 class _ChunkPacker:
@@ -292,6 +322,9 @@ class _ChunkPacker:
             self.hi_only_names = list(layout["hi_only"])
             self.wide_names = list(layout["wide"])
             self.masked_names = list(layout["masked"])
+            for n in self.pair_names:
+                if getattr(cols.get(n), "_exact_compare", False):
+                    _warn_pair_compare_once(n, cols.get(n))
         else:
             f32_mode = _transfer_f32()
             f64_mode = _compute_f64()
@@ -982,6 +1015,9 @@ def run_scan(
     if cache is not None:
         chunk = cache.chunk
         packer = cache.packer
+        for name in packer.pair_names:
+            if getattr(cols.get(name), "_exact_compare", False):
+                _warn_pair_compare_once(name, cols.get(name))
     else:
         chunk = chunk_rows or min(_auto_chunk_rows(cols), max(n_rows, 1))
         # static shapes: round the chunk up so it splits evenly across devices

@@ -208,6 +208,14 @@ def _enc_freq(state) -> bytes:
             out.append(bytes([_KCOL_BOOL]))
             out.append(np.packbits(values).tobytes())
         elif kind in "iu":
+            if kind == "u" and len(values) and int(values.max()) >= 2 ** 63:
+                # the wire format is <i8; uint64 keys >= 2^63 would wrap on
+                # round-trip. No constructor produces unsigned key arrays
+                # today, so refuse loudly rather than corrupt silently.
+                raise ValueError(
+                    "frequency state has unsigned int group keys >= 2^63; "
+                    "the <i8 wire format cannot represent them"
+                )
             out.append(bytes([_KCOL_INT]))
             out.append(np.ascontiguousarray(values, dtype="<i8").tobytes())
         else:

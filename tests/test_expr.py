@@ -100,3 +100,60 @@ def test_string_column_vs_string_column():
 def test_quote_in_string_literal():
     t = ColumnarTable.from_pydict({"name": ["O'Brien", "Smith"]})
     assert mask(r"name = 'O\'Brien'", t) == [True, False]
+
+
+def test_equality_predicate_exact_on_pair_unsafe_values():
+    """Columns referenced by comparison boundaries route over the exact
+    wide-f64 plane (r4 advisor finding: the ~49-bit f32 pair flips
+    x == 0.1 for rows matching exactly); aggregates on other columns keep
+    the pair path."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Compliance, Mean
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    vals = np.array([0.1, 0.2, 0.3, 0.1, 5.0, 1 / 3])
+    t = ColumnarTable([
+        Column("x", DType.FRACTIONAL, values=vals),
+        Column("y", DType.FRACTIONAL, values=vals + 1.0),
+    ])
+    analyzers = [
+        Compliance("eq", "x == 0.1"),
+        Compliance("ge", "x >= 1/3"),
+        Compliance("bt", "x between 0.1 and 1/3"),
+        Mean("y"),
+    ]
+    ctx = AnalysisRunner.do_analysis_run(t, analyzers)
+    assert ctx.metric_map[analyzers[0]].value.get() == 2 / 6
+    assert ctx.metric_map[analyzers[1]].value.get() == 2 / 6
+    assert ctx.metric_map[analyzers[2]].value.get() == 5 / 6
+    assert abs(ctx.metric_map[analyzers[3]].value.get() - np.mean(vals + 1.0)) < 1e-12
+    # routing is per-column: x went wide, y kept the pair
+    assert getattr(t["x"], "_exact_compare", False)
+    assert not getattr(t["y"], "_exact_compare", False)
+
+
+def test_pinned_pair_layout_with_comparison_warns():
+    """A table persisted BEFORE the predicate was declared keeps its pair
+    layout; the packer then warns about the ~1e-16 boundary caveat instead
+    of silently diverging."""
+    import warnings
+
+    import numpy as np
+
+    from deequ_tpu.analyzers import Compliance
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops import scan_engine
+
+    vals = np.array([0.1, 0.2, 0.3, 0.1, 5.0, 1 / 3])
+    t = ColumnarTable([Column("x", DType.FRACTIONAL, values=vals)]).persist()
+    try:
+        scan_engine._PAIR_COMPARE_WARNED.clear()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            AnalysisRunner.do_analysis_run(t, [Compliance("eq", "x == 0.1")])
+        assert any("two-float" in str(w.message) for w in caught)
+    finally:
+        t.unpersist()

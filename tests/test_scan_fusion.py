@@ -534,3 +534,77 @@ def test_numeric_unique_inverse_two_phase_large_n():
     vv = vals[mask]
     same = (decoded == vv) | (np.isnan(decoded) & np.isnan(vv))
     assert same.all()
+
+
+def test_advice_r4_low_findings_regressions():
+    """r4 advisor low findings: NaN dict-keys collapse like the columnar
+    path; int64-min merge guard doesn't wrap; unsigned >= 2^63 keys refuse
+    serde; histogram boundary ties break deterministically by key."""
+    import numpy as np
+    import pytest
+
+    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows, Histogram
+
+    # two distinct float('nan') objects are distinct dict keys -> ONE group
+    n1, n2 = float("nan"), float("nan")
+    st = FrequenciesAndNumRows.from_dict(("x",), {(n1,): 2, (n2,): 3, (1.0,): 1}, 6)
+    assert st.num_groups == 2
+    assert sorted(st.counts.tolist()) == [1, 5]
+
+    # int64 min in an int/float merge: abs() used to wrap negative and
+    # skip the 2^53 collapse guard
+    big = FrequenciesAndNumRows(
+        ("x",), (np.array([np.iinfo(np.int64).min]),),
+        (np.zeros(1, dtype=bool),), np.array([1]), 1)
+    flt = FrequenciesAndNumRows(
+        ("x",), (np.array([0.5]),), (np.zeros(1, dtype=bool),),
+        np.array([1]), 1)
+    with pytest.raises(ValueError, match="2\\^53"):
+        big.sum(flt)
+
+    # unsigned >= 2^63 keys: loud refusal, not silent wrap
+    from deequ_tpu.states.serde import serialize_state
+    ust = FrequenciesAndNumRows(
+        ("x",), (np.array([2 ** 63], dtype=np.uint64),),
+        (np.zeros(1, dtype=bool),), np.array([1]), 1)
+    with pytest.raises(ValueError, match="unsigned"):
+        serialize_state(ust)
+
+    # histogram detail-bin boundary tie: selection is by stringified key,
+    # stable regardless of group order in the state
+    def hist_for(order):
+        vals = np.array([f"k{i}" for i in order])
+        counts = np.array([5] + [3] * (len(order) - 1))  # all but one tied
+        st = FrequenciesAndNumRows(
+            ("c",), (vals,), (np.zeros(len(order), dtype=bool),), counts, 14)
+        m = Histogram("c", max_detail_bins=3).compute_metric_from(st)
+        return set(m.value.get().values.keys())
+
+    sel_a = hist_for([0, 1, 2, 3])
+    sel_b = hist_for([0, 3, 2, 1])  # same data, different state order
+    assert sel_a == sel_b
+
+
+def test_histogram_fast_path_matches_state_path_at_boundary_tie():
+    """A count tie straddling max_detail_bins makes the device fast path
+    fall back to the state path's deterministic key tie-break: both modes
+    must return the SAME bin set (review finding on the r5 tie-break)."""
+    import numpy as np
+
+    from deequ_tpu.analyzers.grouping import Histogram
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.states import InMemoryStateProvider
+
+    # k9 x5, then k1,k2,k3 x3 each: bins=3 -> tie at the boundary
+    raw = ["k9"] * 5 + ["k1", "k2", "k3"] * 3
+    dic = np.unique(np.array(raw))
+    codes = np.searchsorted(dic, np.array(raw)).astype(np.int32)
+    t = ColumnarTable([Column("c", DType.STRING, codes=codes, dictionary=dic)])
+
+    h = Histogram("c", max_detail_bins=3)
+    fast = h.calculate(t)  # device top-k fast path (with tie fallback)
+    stateful = h.calculate(t, save_states_with=InMemoryStateProvider())
+    assert set(fast.value.get().values.keys()) == set(
+        stateful.value.get().values.keys()
+    )
+    assert fast.value.get().values == stateful.value.get().values
