@@ -234,9 +234,19 @@ class Patterns:
     major-brand credit card numbers.
     """
 
+    # the full public RFC-5322 pattern (emailregex.com), incl. the
+    # quoted-local-part and IP-literal alternatives the reference carries
+    # (PatternMatch.scala:61) — e.g. "a b"@example.com, user@[192.168.0.1]
     EMAIL = (
-        r"""[a-z0-9!#$%&'*+/=?^_`{|}~-]+(?:\.[a-z0-9!#$%&'*+/=?^_`{|}~-]+)*"""
-        r"""@(?:[a-z0-9](?:[a-z0-9-]*[a-z0-9])?\.)+[a-z0-9](?:[a-z0-9-]*[a-z0-9])?"""
+        r"""(?:[a-z0-9!#$%&'*+/=?^_`{|}~-]+(?:\.[a-z0-9!#$%&'*+/=?^_`{|}~-]+)*"""
+        r"""|"(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21\x23-\x5b\x5d-\x7f]"""
+        r"""|\\[\x01-\x09\x0b\x0c\x0e-\x7f])*")"""
+        r"""@(?:(?:[a-z0-9](?:[a-z0-9-]*[a-z0-9])?\.)+"""
+        r"""[a-z0-9](?:[a-z0-9-]*[a-z0-9])?"""
+        r"""|\[(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"""
+        r"""(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?|[a-z0-9-]*[a-z0-9]:"""
+        r"""(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21-\x5a\x53-\x7f]"""
+        r"""|\\[\x01-\x09\x0b\x0c\x0e-\x7f])+)\])"""
     )
     URL = r"""(https?|ftp)://[^\s/$.?#].[^\s]*"""
     SOCIAL_SECURITY_NUMBER_US = (

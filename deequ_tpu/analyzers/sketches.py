@@ -449,6 +449,14 @@ def _kll_state_from_result(
     )
     if sketch is None:
         return None
+    # the summary weights must account for every valid row (KLL compaction
+    # is weight-preserving): a mismatch means the device kernel dropped
+    # data — fail loudly, never return silently-undercounted quantiles
+    if sketch.count != count:
+        raise AssertionError(
+            f"KLL summary weight total {sketch.count} != row count {count}; "
+            "device chunk summary lost rows"
+        )
     return KLLState(
         sketch, float(np.asarray(result["min"])), float(np.asarray(result["max"]))
     )

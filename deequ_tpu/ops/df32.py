@@ -146,6 +146,23 @@ def _pair_tree_sum(s, c, xp, levels: int = TREE_LEVELS):
     return xp.where(xp.isnan(tree), naive, tree)
 
 
+def _sum_product_pair(p, e, xp):
+    """Reduce a product pair (p, e) to an f64 scalar: p in f64, e in f32
+    with one final convert.
+
+    Product pairs do NOT use the compensated f32 tree: the p channel's
+    producer is a multiply, and XLA's fusion duplicates that multiply into
+    both TwoSum consumers, where LLVM may contract ONE copy into an FMA —
+    the two consumers then see different roundings of `p` and the
+    compensation adds noise instead of removing it (measured ~2e-9 rel on
+    30k-row m2 under jit vs 1e-15 eager; the mesh/no-mesh matrix caught
+    it). An f64 reduce of p is immune to contraction; summing e in f32
+    contributes error ~6e-8 * sum|e| ~ 4e-15 * sum|p|, far below the
+    1e-12 target. Cost: one full-length f64 reduce per moment column —
+    only the moment/co-moment ops pay it, plain sums keep the f32 tree."""
+    return xp.sum(p.astype(xp.float64)) + xp.sum(e).astype(xp.float64)
+
+
 def masked_sum(hi, lo, ok, xp):
     """Sum of the pair values where ok — f64 scalar, ~1e-13 accurate."""
     if lo is None:
@@ -192,9 +209,13 @@ def _center(hi, lo, mean64, ok, xp):
         d = xp.where(ok, hi - mean64, 0.0)
         return d, None
     z = _f32(xp, 0.0)
-    dh0 = hi - mh
-    dl0 = lo - ml
-    dh, err = two_sum(dh0, dl0)
+    # hi - mh only rounds exactly inside the Sterbenz range (mh/2..2mh);
+    # outside it the lost bits made chunk m2 association-dependent at
+    # ~1e-9 relative (caught by the single-device test matrix), so capture
+    # them with a TwoSum. The small-term sum (lo - ml + err) rounds at
+    # second order only.
+    s1, e1 = two_sum(hi, -mh)
+    dh, err = two_sum(s1, (lo - ml) + e1)
     dh = xp.where(ok, dh, z)
     dl = xp.where(ok, err, z)
     return dh, dl
@@ -226,7 +247,7 @@ def masked_moments(hi, lo, ok, xp):
         m2 = xp.sum(dh * dh)
     else:
         p, e = _sqr_pair(dh, dl, xp)
-        m2 = _pair_tree_sum(p, e, xp)
+        m2 = _sum_product_pair(p, e, xp)
     return cnt, s, mean, m2
 
 
@@ -249,9 +270,9 @@ def masked_comoments(a_hi, a_lo, b_hi, b_lo, ok, xp):
         y_mk = xp.sum(db64 * db64)
     else:
         pc, ec = _mul_pair(dah, dal, dbh, dbl, xp)
-        ck = _pair_tree_sum(pc, ec, xp)
+        ck = _sum_product_pair(pc, ec, xp)
         pa, ea = _sqr_pair(dah, dal, xp)
-        x_mk = _pair_tree_sum(pa, ea, xp)
+        x_mk = _sum_product_pair(pa, ea, xp)
         pb, eb = _sqr_pair(dbh, dbl, xp)
-        y_mk = _pair_tree_sum(pb, eb, xp)
+        y_mk = _sum_product_pair(pb, eb, xp)
     return cnt, ma, mb, ck, x_mk, y_mk

@@ -84,10 +84,19 @@ def chunk_summary(x, valid, sketch_size: int, local_n: int, xp, lo=None):
     m = valid.sum()
 
     # weight w = 2^L with L = ceil(log2(ceil(m/k))): the smallest power of
-    # two reducing m items to <= k strata
+    # two reducing m items to <= k strata. Computed with an INTEGER shift:
+    # XLA's float exp2/log2 are not exact at integer points (CPU x64
+    # exp2(3.0) = 7.999999999999998, truncating to w=7 — which silently
+    # dropped ~10% of rows on the single-device path until the mesh/no-mesh
+    # test matrix caught it). The epsilon guards log2 landing just above an
+    # integer; the where() doubles w if it still came out one step short,
+    # making w exact regardless of libm rounding.
     ratio = xp.maximum((m + k - 1) // k, 1)
-    log2r = xp.ceil(xp.log2(ratio.astype(xp.float64)))
-    w = xp.exp2(log2r).astype(m.dtype)
+    log2r = xp.ceil(xp.log2(ratio.astype(xp.float64)) - 1e-9)
+    w = xp.left_shift(
+        xp.asarray(1, dtype=m.dtype), log2r.astype(m.dtype)
+    )
+    w = xp.where(w * k < m, w * 2, w)
     n_strata = m // w
 
     # strata midpoints: item i represents rows [i*w, (i+1)*w)

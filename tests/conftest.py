@@ -34,6 +34,40 @@ def _reset_scan_stats():
     yield
 
 
+# -- mesh/no-mesh matrix -----------------------------------------------------
+#
+# The whole suite runs on the virtual 8-device mesh; a single-device-only
+# regression (use_mesh(None) branches in the engine) would otherwise escape
+# to the real TPU, where exactly that class of bug appeared in round 4
+# (r4 verdict weak-spot 5). The core engine suites therefore run TWICE:
+# under the mesh and with the mesh disabled.
+
+_MESH_MATRIX_MODULES = {
+    "test_scan_fusion",
+    "test_incremental",
+    "test_streaming",
+    "test_analyzers",
+}
+
+
+def pytest_generate_tests(metafunc):
+    name = metafunc.module.__name__.rsplit(".", 1)[-1]
+    if name in _MESH_MATRIX_MODULES and "_mesh_mode" in metafunc.fixturenames:
+        metafunc.parametrize("_mesh_mode", ["mesh8", "single"], indirect=True)
+
+
+@pytest.fixture(autouse=True)
+def _mesh_mode(request):
+    mode = getattr(request, "param", "mesh8")
+    if mode == "single":
+        from deequ_tpu.parallel.mesh import use_mesh
+
+        with use_mesh(None):
+            yield
+    else:
+        yield
+
+
 # -- fixture tables (the analogue of utils/FixtureSupport.scala:26-259) -----
 
 
