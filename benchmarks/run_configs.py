@@ -196,13 +196,29 @@ def config5_from_disk(n_batches: int, batch_rows: int, tmpdir: str = "/tmp"):
     )
 
 
-def config5(n_batches: int, batch_rows: int, pipelined: bool = True, seed: int = 44):
+def config5(
+    n_batches: int,
+    batch_rows: int,
+    pipelined: bool = True,
+    seed: int = 44,
+    with_strings: bool = False,
+):
     """Incremental state stream + anomaly detection over the repository
     (BASELINE config #5 shape, scaled). ``pipelined`` uses the round-4
     IncrementalAnalysisStream (several batches' scans in flight, drains
     FIFO) — the serial loop pays one full device fetch round trip per
-    batch."""
-    from deequ_tpu.analyzers import Mean, Size, StandardDeviation
+    batch. ``with_strings`` adds a dictionary-encoded string column with
+    PatternMatch + MaxLength (the realistic monitoring-stream shape; the
+    r5 group path carries dictionary LUTs as stacked jit arguments, so
+    the pipeline no longer excludes it)."""
+    from deequ_tpu.analyzers import (
+        Completeness,
+        MaxLength,
+        Mean,
+        PatternMatch,
+        Size,
+        StandardDeviation,
+    )
     from deequ_tpu.analyzers.incremental import IncrementalAnalysisStream
     from deequ_tpu.analyzers.runner import AnalysisRunner
     from deequ_tpu.anomaly import AnomalyDetector, OnlineNormalStrategy
@@ -213,19 +229,39 @@ def config5(n_batches: int, batch_rows: int, pipelined: bool = True, seed: int =
     from deequ_tpu.states import InMemoryStateProvider
 
     analyzers = [Size(), Mean("v"), StandardDeviation("v")]
+    if with_strings:
+        analyzers += [
+            Completeness("s"),
+            PatternMatch("s", r"^[a-z0-9]+@[a-z.]+$"),
+            MaxLength("s"),
+        ]
     repo = InMemoryMetricsRepository()
     states = InMemoryStateProvider()
     rng = np.random.default_rng(seed)
 
     # pre-generate batches: data generation is not part of the measured
     # incremental loop (batches "arrive")
-    batches = [
-        ColumnarTable(
-            [Column("v", DType.FRACTIONAL,
-                    values=rng.normal(100.0, 5.0, batch_rows))]
-        )
-        for _ in range(n_batches)
-    ]
+    batches = []
+    for b in range(n_batches):
+        cols = [
+            Column("v", DType.FRACTIONAL,
+                   values=rng.normal(100.0, 5.0, batch_rows))
+        ]
+        if with_strings:
+            card = 1000 + 13 * b  # fresh dictionary per batch, like prod
+            dic = np.array(
+                [
+                    f"user{i}@mail.com" if i % 5 else f"bad row {i}"
+                    for i in range(card)
+                ]
+            )
+            cols.append(
+                Column("s", DType.STRING,
+                       codes=rng.integers(0, card, batch_rows).astype(
+                           np.int32),
+                       dictionary=dic)
+            )
+        batches.append(ColumnarTable(cols))
 
     t0 = time.time()
     if pipelined:
@@ -282,6 +318,11 @@ def main():
         3: lambda: config3(args.rows or 4_000_000),
         4: lambda: config4(args.rows or 4_000_000),
         5: lambda: config5(50, (args.rows or 10_000_000) // 50),
+        # config 5 with a string column (PatternMatch/MaxLength): the
+        # realistic monitoring stream; LUTs ride the pipelined group path
+        55: lambda: config5(
+            50, (args.rows or 10_000_000) // 50, with_strings=True
+        ),
         # config 5 with batches read out-of-core from Parquet on disk
         # (python benchmarks/run_configs.py --config 50)
         50: lambda: config5_from_disk(20, (args.rows or 10_000_000) // 20),

@@ -236,7 +236,8 @@ class Patterns:
 
     # the full public RFC-5322 pattern (emailregex.com), incl. the
     # quoted-local-part and IP-literal alternatives the reference carries
-    # (PatternMatch.scala:61) — e.g. "a b"@example.com, user@[192.168.0.1]
+    # (PatternMatch.scala:61) — e.g. "quoted.local"@example.com,
+    # "a\\ b"@example.com (escaped space), user@[192.168.0.1]
     EMAIL = (
         r"""(?:[a-z0-9!#$%&'*+/=?^_`{|}~-]+(?:\.[a-z0-9!#$%&'*+/=?^_`{|}~-]+)*"""
         r"""|"(?:[\x01-\x08\x0b\x0c\x0e-\x1f\x21\x23-\x5b\x5d-\x7f]"""

@@ -64,15 +64,28 @@ from deequ_tpu.tryresult import Failure, Success, Try
 
 @dataclass(frozen=True)
 class ApproxCountDistinctState(DoubleValuedState):
-    """HLL register file; merge = elementwise register max."""
+    """HLL register file; merge = elementwise register max.
+
+    ``hash_version`` stamps which hash suite filled the registers (2 =
+    the r5 u32-native path, 1 = the u64 splitmix path of rounds 1-4).
+    Registers hashed with different suites count DIFFERENT bucketings of
+    the same values — merging them double-counts, so sum() refuses."""
 
     registers: Tuple[int, ...]
+    hash_version: int = hll_ops.HASH_VERSION
 
     def sum(self, other: "ApproxCountDistinctState") -> "ApproxCountDistinctState":
         if len(self.registers) != len(other.registers):
             raise ValueError("cannot merge HLL states with different precision")
+        if self.hash_version != other.hash_version:
+            raise ValueError(
+                f"cannot merge HLL registers hashed with different suites "
+                f"(v{self.hash_version} vs v{other.hash_version}); recompute "
+                f"the older state with this version"
+            )
         return ApproxCountDistinctState(
-            tuple(max(a, b) for a, b in zip(self.registers, other.registers))
+            tuple(max(a, b) for a, b in zip(self.registers, other.registers)),
+            self.hash_version,
         )
 
     def metric_value(self) -> float:
@@ -105,43 +118,71 @@ class ApproxCountDistinct(ScanShareableAnalyzer):
         dtype = table[col].dtype
         p = hll_ops.precision_from_relative_sd()
 
+        # string registers keep the v1 content (host xxhash64 + u64
+        # idx/rank derivation, just gathered as a packed i32 LUT), so
+        # they stay suite 1 and MERGE with pre-v4 persisted states;
+        # numeric/boolean registers come from the u32 suite (2)
+        hash_version = 1 if dtype == DType.STRING else hll_ops.HASH_VERSION
+
         def update(vals, row_valid, xp, n):
             rows = _rows(vals, row_valid, xp, n, pred)
             v = vals[col]
             if dtype == DType.STRING:
-                hashes = v.lut("xxhash64")[xp.maximum(v.data, 0)]
+                # host-precomputed packed (idx, rank) per distinct value:
+                # the device only gathers + unpacks with native i32 ops
+                packed = v.lut(f"hll_ir_p{p}")[xp.maximum(v.data, 0)]
+                idx = (packed >> xp.int32(6)).astype(xp.int32)
+                rank = (packed & xp.int32(0x3F)).astype(xp.int32)
                 valid = rows & (v.data >= 0)
             elif dtype == DType.BOOLEAN:
-                hashes = hll_ops.splitmix64(
-                    v.data.astype(xp.uint64) ^ xp.uint64(42), xp
+                bits = v.data.astype(xp.uint32)
+                idx, rank = hll_ops.idx_rank_u32(
+                    bits, xp.zeros_like(bits), p, xp
                 )
                 valid = rows & v.mask
             elif v.lo is not None:
-                # two-float pair column: the pair IS the hash key the f64
-                # path derives (hll.py:_f64_key_u64), so hashing it directly
-                # is bit-identical and skips the f64 split on device
-                hashes = hll_ops.hash_pair_device(v.data, v.lo, xp)
+                # two-float pair column: the packer's planes ARE the
+                # canonical split idx_rank_numeric derives, so bitcasting
+                # them directly is bit-identical — and all-u32 (no
+                # emulated u64 ops; r4's dominant device compute term)
+                idx, rank = hll_ops.idx_rank_pair_device(v.data, v.lo, p, xp)
                 valid = rows & v.mask
             else:
-                hashes = hll_ops.hash_numeric_device(v.data, xp)
+                idx, rank = hll_ops.idx_rank_numeric(v.data, p, xp)
                 valid = rows & v.mask
-            regs = hll_ops.registers_from_hashes(hashes, valid, p, xp)
-            return {"registers": regs}
+            regs = hll_ops.registers_from_idx_rank(idx, rank, valid, p, xp)
+            # suite id rides the result pytree (tag "max" = identity
+            # across chunk/device merges) so state_from_scan_result can
+            # stamp the state without re-knowing the column dtype
+            return {
+                "registers": regs,
+                "hash_version": xp.asarray(hash_version, dtype=xp.int32),
+            }
 
         luts = (
-            ((col, "xxhash64", hll_ops.hash_strings),)
+            (
+                (
+                    col,
+                    f"hll_ir_p{p}",
+                    lambda d, _p=p: hll_ops.string_idx_rank_lut(d, _p),
+                ),
+            )
             if dtype == DType.STRING
             else ()
         )
         return ScanOp(
-            tuple(sorted(cols)), update, {"registers": "max"},
+            tuple(sorted(cols)), update,
+            {"registers": "max", "hash_version": "max"},
             luts=luts,
             dictionary_baked=_string_baked(table, wcols),
         )
 
     def state_from_scan_result(self, result) -> Optional[ApproxCountDistinctState]:
         regs = np.asarray(result["registers"]).astype(np.int64)
-        return ApproxCountDistinctState(tuple(int(r) for r in regs))
+        return ApproxCountDistinctState(
+            tuple(int(r) for r in regs),
+            int(np.asarray(result["hash_version"])),
+        )
 
     def compute_metric_from(self, state) -> DoubleMetric:
         if state is None:

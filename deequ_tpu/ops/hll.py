@@ -163,27 +163,6 @@ def hash_numeric_device(values, xp, seed: int = XXHASH_SEED):
     return splitmix64(bits ^ xp.uint64((seed * 0x9E3779B97F4A7C15) & _MASK64), xp)
 
 
-def hash_pair_device(hi, lo, xp, seed: int = XXHASH_SEED):
-    """Hash two-float pair columns (ops/df32.py) on device.
-
-    The packer's (hi, lo) planes are exactly the double-float split
-    _f64_key_u64 derives from f64 values (same canonical +0.0 fold, same
-    rounding), so bitcasting them directly yields a BIT-IDENTICAL key —
-    pair-path HLL states merge with f64-path and host-built ones.
-    """
-    import jax
-
-    # the packer already canonicalizes -0.0 and pair columns exclude
-    # |x| > f32_max, so the only divergence from _f64_key_u64 is at
-    # x = +/-inf/NaN, where that path's residual is NaN but the packer
-    # zeroes it (so sums stay IEEE-correct); restore NaN for the key
-    lo = xp.where(xp.isfinite(hi), lo, xp.asarray(np.float32(np.nan)))
-    hi_bits = jax.lax.bitcast_convert_type(hi, xp.uint32).astype(xp.uint64)
-    lo_bits = jax.lax.bitcast_convert_type(lo, xp.uint32).astype(xp.uint64)
-    bits = (hi_bits << xp.uint64(32)) | lo_bits
-    return splitmix64(bits ^ xp.uint64((seed * 0x9E3779B97F4A7C15) & _MASK64), xp)
-
-
 def clz64(x, xp):
     """Branchless count-leading-zeros for uint64 arrays."""
     n = xp.full(xp.shape(x), 64, dtype=xp.int32)
@@ -193,6 +172,119 @@ def clz64(x, xp):
         x = xp.where(hit, y, x)
         n = n - xp.where(hit, xp.int32(s), xp.int32(0))
     return n - (x != 0).astype(xp.int32)
+
+
+# -- u32-native hash path (register format v2) -------------------------------
+#
+# u64 arithmetic is software-emulated on TPU v5e; the r4 profile showed
+# the 4 HLL columns' splitmix64 + 6-step clz64 as the DOMINANT device
+# compute of the whole 105-metric scan (~15ms/column). The v2 path works
+# in the u32 domain end to end: the packer's (hi, lo) f32 planes bitcast
+# to two u32 lanes (32-bit bitcasts are native; the tunnel compiler
+# rejects 64-bit ones anyway), two murmur3 fmix32 finalizers (public
+# constants) mix them with cross-dependence, and idx/rank come from
+# native u32 shifts with a 5-step clz32. The rank still spans the same
+# [1, 64-p+1] domain (32-p bits of lane A, then 32 bits of lane B), so
+# the Ertl estimator is unchanged. Registers hashed this way are NOT
+# mergeable with v1 (u64 splitmix) registers — ApproxCountDistinctState
+# carries hash_version and refuses cross-version merges; string columns
+# keep host xxhash64 (content-identical to v1) but are stamped v2 too.
+
+HASH_VERSION = 2
+
+# Measured on the v5e (BENCHMARKS.md r5): the hash+idx/rank stage drops
+# 93% (0.25ms -> 0.02ms per 10M-row column) but the one-hot MXU register
+# FOLD (~14ms/col) dominates the column cost, so the end-to-end HLL win
+# is ~2%. A narrower R=32 fold was tried and measured SLOWER (20ms) than
+# R=64 — the (n, 64) one-hot tiles better on the 128-lane MXU — so ranks
+# keep the full 64 - p + 1 cap and the fold keeps R = 64. The u32 path
+# stays the default anyway: it removes every software-emulated u64 op
+# from the device (a tunnel-compiler risk surface) and halves the
+# string-LUT transfer bytes (packed i32 vs u64 hashes).
+
+
+def fmix32(x, xp):
+    """murmur3's 32-bit avalanche finalizer (public constants)."""
+    x = x ^ (x >> xp.uint32(16))
+    x = x * xp.uint32(0x85EBCA6B)
+    x = x ^ (x >> xp.uint32(13))
+    x = x * xp.uint32(0xC2B2AE35)
+    return x ^ (x >> xp.uint32(16))
+
+
+def clz32(x, xp):
+    """Branchless count-leading-zeros for uint32 arrays."""
+    n = xp.full(xp.shape(x), 32, dtype=xp.int32)
+    for s in (16, 8, 4, 2, 1):
+        y = x >> xp.uint32(s)
+        hit = y != 0
+        x = xp.where(hit, y, x)
+        n = n - xp.where(hit, xp.int32(s), xp.int32(0))
+    return n - (x != 0).astype(xp.int32)
+
+
+def idx_rank_u32(hi_bits, lo_bits, p: int, xp, seed: int = XXHASH_SEED):
+    """(idx, rank) for the HLL fold from two u32 lanes, all-u32 compute.
+
+    BOTH output words mix BOTH input lanes: for dense float clusters the
+    distinguishing entropy lives almost entirely in the lo lane (hi is
+    the f32 rounding, ~2^23 granularity), so a word derived from hi
+    alone caps the observable cardinality at the distinct-hi count — a
+    first formulation made exactly that mistake and underestimated 10M
+    normals 4x. a = fmix32(fmix32(hi ^ seed) ^ lo) provides idx (top p
+    bits) + the first 32-p rank bits; b mixes the lanes in the opposite
+    order with a different seed and extends the geometric tail to the
+    full 64-p bits, so rank spans [1, 64-p+1] like the v1 u64 path."""
+    s = xp.uint32(seed & 0xFFFFFFFF)
+    a = fmix32(fmix32(hi_bits ^ s, xp) ^ lo_bits, xp)
+    b = fmix32(fmix32(lo_bits ^ s ^ xp.uint32(0x9E3779B9), xp) ^ hi_bits, xp)
+    idx = (a >> xp.uint32(32 - p)).astype(xp.int32)
+    w1 = a << xp.uint32(p)
+    r1 = clz32(w1, xp) + 1                     # w1 == 0 -> 33
+    r2 = clz32(b, xp) + 1
+    rank = xp.where(w1 != 0, r1, xp.int32(32 - p) + r2)
+    return idx, xp.minimum(rank, 64 - p + 1)
+
+
+def _pair_bits_u32(hi, lo, xp):
+    """Bitcast the packer's (hi, lo) f32 planes to u32 lanes. Restores the
+    NaN residual for non-finite values (the packer zeroes it so sums stay
+    IEEE-correct) — matching what a from-f64 split derives."""
+    if xp is np:
+        with np.errstate(invalid="ignore"):
+            lo = np.where(np.isfinite(hi), lo, np.float32(np.nan))
+        return hi.view(np.uint32), lo.view(np.uint32)
+    import jax
+
+    lo = xp.where(xp.isfinite(hi), lo, xp.asarray(np.float32(np.nan)))
+    return (
+        jax.lax.bitcast_convert_type(hi, xp.uint32),
+        jax.lax.bitcast_convert_type(lo, xp.uint32),
+    )
+
+
+def idx_rank_pair_device(hi, lo, p: int, xp, seed: int = XXHASH_SEED):
+    """(idx, rank) straight from two-float pair planes — no u64 ops."""
+    hb, lb = _pair_bits_u32(hi, lo, xp)
+    return idx_rank_u32(hb, lb, p, xp, seed)
+
+
+def idx_rank_numeric(values, p: int, xp, seed: int = XXHASH_SEED):
+    """(idx, rank) from f64 values via the canonical double-float split
+    (same split as the packer, so pair-path and wide-path registers are
+    bit-identical; host numpy uses the identical formula so states merge
+    across platforms)."""
+    canonical = values + 0.0  # fold -0.0 into +0.0
+    if xp is np:
+        with np.errstate(over="ignore", invalid="ignore"):
+            hi = canonical.astype(np.float32)
+            diff = canonical - hi.astype(np.float64)
+            lo = np.where(np.isfinite(diff), diff, 0.0).astype(np.float32)
+    else:
+        hi = canonical.astype(xp.float32)
+        diff = canonical - hi.astype(xp.float64)
+        lo = xp.where(xp.isfinite(diff), diff, 0.0).astype(xp.float32)
+    return idx_rank_pair_device(hi, lo, p, xp, seed)
 
 
 _MXU_FOLD_BLOCK = 1 << 22
@@ -209,9 +301,11 @@ def _registers_mxu_fold(idx, rank, m: int, xp):
     vs 197ms for 10M rows, and it fuses into the surrounding scan).
     Exactness: one-hot products are 0/1 in bf16, accumulation is f32
     (counts are non-negative, so presence > 0 survives any f32 rounding).
+    The one-hot rank width R = 64 covers every rank cap and tiles BEST
+    on the 128-lane MXU (R = 32 measured ~40% slower, BENCHMARKS.md r5).
     """
     n = idx.shape[0]
-    R = 64  # rank <= 64 - p + 1 <= 57, rounded up to a lane-friendly 64
+    R = 64
     C = xp.zeros((m, R), dtype=xp.float32)
     block = _MXU_FOLD_BLOCK
     import jax
@@ -226,21 +320,43 @@ def _registers_mxu_fold(idx, rank, m: int, xp):
     return (present * xp.arange(R)).max(axis=1).astype(xp.int32)
 
 
-def registers_from_hashes(hashes, valid, p: int, xp):
-    """Fold a chunk of 64-bit hashes into an HLL register file on device.
-
-    idx = top p bits, rank = clz(remaining bits) + 1; registers take the max
-    rank per idx. Invalid rows contribute rank 0. Lowering paths: one-hot
-    bf16 matmul on the MXU (default for large device chunks) or XLA
-    segment_max (small chunks / host numpy).
-    """
-    import jax
-
-    m = 1 << p
+def idx_rank_from_hash64(hashes, p: int, xp):
+    """(idx, rank) from 64-bit hashes — the v1 derivation, still used for
+    string columns whose xxhash64 LUT is computed on HOST (numpy u64 ops
+    are cheap there; the device only gathers i32 idx/rank)."""
     idx = (hashes >> xp.uint64(64 - p)).astype(xp.int32)
     rest = hashes << xp.uint64(p)
     rank = (clz64(rest, xp) + 1).astype(xp.int32)
-    rank = xp.minimum(rank, 64 - p + 1)
+    return idx, xp.minimum(rank, 64 - p + 1)
+
+
+def pack_idx_rank(idx, rank):
+    """Host LUT packing: one i32 per distinct value (rank <= 57 fits in
+    6 bits). The device unpacks with native i32 shifts/masks."""
+    return (idx.astype(np.int32) << np.int32(6)) | rank.astype(np.int32)
+
+
+def string_idx_rank_lut(values, p: int, seed: int = XXHASH_SEED) -> np.ndarray:
+    """Packed (idx, rank) LUT for a string dictionary: xxhash64 per
+    distinct value on host, u64 idx/rank derivation on host, i32 out —
+    register contents identical to hashing the values with v1."""
+    hashes = hash_strings(values, seed)
+    idx, rank = idx_rank_from_hash64(hashes, p, np)
+    packed = pack_idx_rank(idx, rank)
+    return packed if len(packed) else np.zeros(1, dtype=np.int32)
+
+
+def registers_from_idx_rank(idx, rank, valid, p: int, xp):
+    """Fold (idx, rank) rows into an HLL register file on device.
+
+    Registers take the max rank per idx; invalid rows contribute rank 0.
+    Lowering paths: one-hot bf16 matmul on the MXU (default for large
+    device chunks) or XLA segment_max (small chunks / host numpy).
+    The fold's one-hot width is fixed at 64: it covers every rank cap
+    and measured FASTER than 32 on the 128-lane MXU."""
+    import jax
+
+    m = 1 << p
     rank = xp.where(valid, rank, 0)
     idx = xp.where(valid, idx, 0)
 
@@ -264,6 +380,13 @@ def registers_from_hashes(hashes, valid, p: int, xp):
         rank, idx, num_segments=m, indices_are_sorted=False
     ).astype(xp.int32)
     return xp.maximum(regs, 0)  # untouched segments fill with INT_MIN
+
+
+def registers_from_hashes(hashes, valid, p: int, xp):
+    """Fold 64-bit hashes into a register file (v1 derivation; host paths
+    and tests)."""
+    idx, rank = idx_rank_from_hash64(hashes, p, xp)
+    return registers_from_idx_rank(idx, rank, valid, p, xp)
 
 
 def _sigma(x: float) -> float:

@@ -1162,16 +1162,21 @@ class DeferredGroupScan:
 def group_scannable(tables, ops, mesh):
     """The shared packer layout (truthy) when run_scan_group supports
     this workload, else False: single-device, EQUAL-SIZE batches whose
-    NEEDED columns are numeric and share one schema AND one packer
-    layout, ops without dictionary LUTs (per-batch dictionaries would
-    need per-batch lut arguments). Equal sizes keep the group path
-    bit-identical to per-batch scans: padding a batch to a larger chunk
-    changes the f32-pair reduction association at the ulp level, which
-    the pipelined==serial contract forbids (unequal batches fall back to
-    per-batch deferred scans, which are exactly the serial programs)."""
+    NEEDED columns share one schema AND one packer layout. String
+    columns are fine — their per-batch dictionary dependence rides in
+    as stacked LUT ARGUMENTS (each table's LUT padded to the group-max
+    pow2; gathers never touch padding, so per-batch results stay
+    bit-identical) — but ops that read the dictionary at TRACE time
+    (dictionary_baked, e.g. string-literal predicates) would bake the
+    first table's constants and are rejected. Equal sizes keep the group
+    path bit-identical to per-batch scans: padding a batch to a larger
+    chunk changes the f32-pair reduction association at the ulp level,
+    which the pipelined==serial contract forbids (unequal batches fall
+    back to per-batch deferred scans, which are exactly the serial
+    programs)."""
     if mesh is not None:
         return False
-    if any(op.luts or op.dictionary_baked for op in ops):
+    if any(op.dictionary_baked for op in ops):
         return False
     needed = sorted({c for op in ops for c in op.columns})
     first = tables[0]
@@ -1198,8 +1203,6 @@ def group_scannable(tables, ops, mesh):
         if any(n not in t for n in needed):
             return False
         if [(n, t[n].dtype) for n in needed] != sig:
-            return False
-        if any(t[n].dtype == DType.STRING for n, _ in sig):
             return False
         layout = _ChunkPacker({n: t[n] for n in needed}, n_rows).layout()
         if layout0 is None:
@@ -1260,7 +1263,42 @@ def run_scan_group(
                 lst.append(a)
     bufs = tuple(np.stack(lst) for lst in stacked)
 
-    prog_key = _ops_prog_key(ops, chunk, ())
+    # per-table dictionary LUTs stacked to (K, L_groupmax): each table's
+    # LUT pads to the GROUP's max pow2 size — padding slots are never
+    # gathered (codes < that table's cardinality), so per-batch results
+    # stay bit-identical to the serial path's individually-padded LUTs
+    lut_stacked: Dict[str, Any] = {}
+    lut_specs = {}
+    for op in ops:
+        for col, kind, builder in op.luts:
+            lut_specs.setdefault(col + "\x00" + kind, (col, kind, builder))
+    if lut_specs:
+        from deequ_tpu.ops.lut_cache import dictionary_lut
+
+        for key, (col, kind, builder) in lut_specs.items():
+            per_table = [
+                dictionary_lut(t[col].dictionary, kind, builder)
+                for t in tables
+            ]
+            target = 1
+            while target < max(len(a) for a in per_table):
+                target <<= 1
+            padded = []
+            for a in per_table:
+                if len(a) < target:
+                    out = np.zeros(target, dtype=a.dtype)
+                    out[: len(a)] = a
+                    a = out
+                padded.append(a)
+            lut_stacked[key] = jax.device_put(np.stack(padded))
+    lut_sig = tuple(
+        sorted(
+            (key, tuple(int(d) for d in arr.shape), str(arr.dtype))
+            for key, arr in lut_stacked.items()
+        )
+    )
+
+    prog_key = _ops_prog_key(ops, chunk, lut_sig)
     global_key = None
     if prog_key is not None:
         gk = _global_prog_key(prog_key, packer, None)
@@ -1275,9 +1313,14 @@ def run_scan_group(
         SCAN_STATS.programs_built += 1
         view = packer.unpack_view()
 
-        def single_tree(values, hi, lo, narrow_i, masks, codes, row_valid):
+        def single_tree(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
+            col_luts: Dict[str, Dict[str, Any]] = {}
+            for key, arr in luts.items():
+                lcol, lkind = _split_lut_key(key)
+                col_luts.setdefault(lcol, {})[lkind] = arr
             vals = view.unpack_vals(
                 values, hi, lo, narrow_i, masks, codes, jnp, row_valid,
+                col_luts=col_luts,
             )
             return tuple(
                 jax.tree.map(
@@ -1295,7 +1338,11 @@ def run_scan_group(
             )
 
         vstep = jax.jit(jax.vmap(single_flat))
-        shapes = jax.eval_shape(single_tree, *(b[0] for b in bufs))
+        shapes = jax.eval_shape(
+            single_tree,
+            *(b[0] for b in bufs),
+            {k: v[0] for k, v in lut_stacked.items()},
+        )
         if global_key is not None:
             _GLOBAL_PROGRAMS.put(global_key, (vstep, shapes))
 
@@ -1305,7 +1352,7 @@ def run_scan_group(
     import time as _time
 
     t_d = _time.time()
-    device_out = vstep(*bufs)
+    device_out = vstep(*bufs, lut_stacked)
     SCAN_STATS.dispatch_seconds += _time.time() - t_d
 
     folders = []

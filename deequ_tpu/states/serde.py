@@ -27,7 +27,7 @@ from typing import Callable, Dict, Tuple, Type
 from deequ_tpu.analyzers.base import State
 
 MAGIC = b"DQTS"
-VERSION = 3
+VERSION = 4
 
 _u16 = struct.Struct("<H")
 _i64 = struct.Struct("<q")
@@ -114,14 +114,23 @@ def _codec_scalars(cls, fields: str):
 
 def _enc_hll(state) -> bytes:
     regs = state.registers
-    return _i64.pack(len(regs)) + bytes(int(r) & 0xFF for r in regs)
+    return (
+        _i64.pack(len(regs))
+        + bytes(int(r) & 0xFF for r in regs)
+        # v4 trailing field: which hash suite filled the registers —
+        # cross-suite merges are refused (ApproxCountDistinctState.sum)
+        + _u16.pack(state.hash_version)
+    )
 
 
 def _dec_hll(buf: bytes, version: int):
     from deequ_tpu.analyzers.sketches import ApproxCountDistinctState
 
     (n,) = _i64.unpack_from(buf, 0)
-    return ApproxCountDistinctState(tuple(buf[8:8 + n]))
+    hash_version = 1  # pre-v4 blobs were always the u64 splitmix suite
+    if version >= 4:
+        (hash_version,) = _u16.unpack_from(buf, 8 + n)
+    return ApproxCountDistinctState(tuple(buf[8:8 + n]), hash_version)
 
 
 def _enc_kll(state) -> bytes:

@@ -83,7 +83,7 @@ def test_golden_num_matches():
     data = serialize_state(NumMatches(42))
     assert data.hex() == (
         "44515453"  # magic DQTS
-        "0300"      # version 3
+        "0400"      # version 4
         "0100"      # tag 1
         "2a00000000000000"  # i64 42
     )
@@ -92,7 +92,7 @@ def test_golden_num_matches():
 def test_golden_mean_state():
     data = serialize_state(MeanState(1.5, 3))
     assert data.hex() == (
-        "44515453" "0300" "0500"
+        "44515453" "0400" "0500"
         "000000000000f83f"  # f64 1.5 LE
         "0300000000000000"  # i64 3
     )
@@ -102,7 +102,7 @@ def test_golden_hll_prefix():
     regs = tuple([2, 0, 5] + [0] * 509)
     data = serialize_state(ApproxCountDistinctState(regs))
     assert data.hex().startswith(
-        "44515453" "0300" "0a00"
+        "44515453" "0400" "0a00"
         "0002000000000000"  # i64 512 (0x200)
         "020005"            # first three registers as bytes
     )
