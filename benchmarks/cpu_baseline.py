@@ -88,13 +88,6 @@ def main():
     )
 
 
-if __name__ == "__main__":
-    import sys as _sys
-
-    if len(_sys.argv) > 1:
-        main_configs(_sys.argv[1:])
-    else:
-        main()
 
 
 # -- measured CPU denominators for the remaining BASELINE configs ------------
@@ -270,3 +263,12 @@ def main_configs(argv):
         cpu_config4(args.rows or 4_000_000)
     elif args.config == 5:
         cpu_config5(50, (args.rows or 10_000_000) // 50)
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    if len(_sys.argv) > 1:
+        main_configs(_sys.argv[1:])
+    else:
+        main()

@@ -1,0 +1,32 @@
+"""Interop with persisted artifacts of the reference deequ (Scala/Spark).
+
+An existing deequ deployment can bring two kinds of durable artifacts:
+
+- the metrics-repository JSON written by Gson
+  (repository/AnalysisResultSerde.scala:38-635) — the metric HISTORY
+  that anomaly detection needs on day one;
+- per-analyzer binary states written by HdfsStateProvider
+  (analyzers/StateProvider.scala:86-311) — portable algebraic states
+  (counts, min/max, moments, the 40-byte DataType histogram, frequency
+  tables as Parquet).
+
+Both import losslessly. Sketch states (HLL register words, the Spark
+percentile digest) are NOT portable — the sketch algebras differ by
+design (ops/hll.py, ops/kll.py docstrings) — and refuse loudly.
+"""
+
+from deequ_tpu.interop.deequ_import import (
+    import_analysis_results,
+    import_repository_json,
+    load_reference_state,
+    reference_state_identifier,
+    scala_murmur3_string_hash,
+)
+
+__all__ = [
+    "import_analysis_results",
+    "import_repository_json",
+    "load_reference_state",
+    "reference_state_identifier",
+    "scala_murmur3_string_hash",
+]
