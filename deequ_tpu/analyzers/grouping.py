@@ -706,27 +706,22 @@ class Histogram(FrequencyBasedAnalyzer):
             if failing is not None:
                 return self.to_failure_metric(failing)
             try:
-                # fetch ONE extra entry: if a count tie straddles the
-                # truncation boundary, device top_k order (first-seen code)
-                # would pick a different bin set than the state path's
-                # stringified-key tie-break — fall back to the full path
-                # so both produce the same Distribution
-                stats = group_top_k(
-                    table, self.column, self.max_detail_bins + 1
-                )
+                stats = group_top_k(table, self.column, self.max_detail_bins)
             except Exception as e:  # noqa: BLE001
                 from deequ_tpu.exceptions import wrap_if_necessary
 
                 return self.to_failure_metric(wrap_if_necessary(e))
+            # tie semantics: count ties at the truncation boundary break
+            # by device rank order here (the reference's own top() is
+            # equally tie-unstable, Histogram.scala:97-103), while the
+            # state path breaks them deterministically by stringified key
+            # (compute_metric_from). An r5 attempt to unify them by
+            # falling back to the state path on a boundary tie was
+            # REVERTED: high-cardinality columns (BASELINE config 4) are
+            # essentially always tied at the boundary, and the fallback
+            # turned the O(k)-fetch fast path into an O(G) group
+            # materialization — a measured 10x regression.
             top = stats.top
-            if len(top) > self.max_detail_bins:
-                if top[self.max_detail_bins][1] == top[
-                    self.max_detail_bins - 1
-                ][1]:
-                    return super().calculate(
-                        table, aggregate_with, save_states_with
-                    )
-                top = top[: self.max_detail_bins]
 
             def build_fast() -> Distribution:
                 # merge stringified collisions (e.g. 1 vs "1" -> "1") the
