@@ -521,7 +521,23 @@ def _mark_exact_compare_columns(expr: Expr, table) -> None:
         return
     for name in boundary_columns(expr):
         if name in names and table[name].dtype == DType.FRACTIONAL:
-            table[name]._exact_compare = True
+            try:
+                table[name]._exact_compare = True
+            except AttributeError:
+                # streaming tables expose slotted schema-only column
+                # views; record the mark on the TABLE — the streaming
+                # scan applies it to every materialized batch before the
+                # packer layout is derived (scan_engine._run_scan_stream).
+                # Sticky by design, like the per-Column mark on in-memory
+                # tables: once ANY predicate compared the column, every
+                # later scan of the same table/stream keeps the exact
+                # wide-f64 routing (conservative; costs ~one column's
+                # worth of f64 reductions, not a mode switch).
+                marked = getattr(table, "_exact_compare_names", None)
+                if marked is None:
+                    marked = set()
+                    table._exact_compare_names = marked
+                marked.add(name)
 
 
 def compile_predicate(src_or_expr, table: ColumnarTable):

@@ -1532,8 +1532,19 @@ def _run_scan_stream(
 
     t_start = _time.time()
 
+    # predicate-compiled boundary columns recorded on the stream (its
+    # schema views can't carry the per-Column mark): apply to every
+    # materialized batch BEFORE the layout is derived/pinned so they
+    # route over the exact wide-f64 plane (expr/eval.py)
+    exact_names = set(
+        getattr(stream, "_exact_compare_names", ()) or ()
+    ) & set(needed)
+
     def process_cols(cols: Dict[str, Column], n: int) -> None:
         nonlocal layout, current_prog
+        for name in exact_names:
+            if name in cols:
+                cols[name]._exact_compare = True
         if layout is None:
             layout = _ChunkPacker(cols, chunk).layout()
         else:

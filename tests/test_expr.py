@@ -157,3 +157,21 @@ def test_pinned_pair_layout_with_comparison_warns():
         assert any("two-float" in str(w.message) for w in caught)
     finally:
         t.unpersist()
+
+
+def test_equality_predicate_exact_on_streaming_table():
+    """Streaming tables carry the exact-compare mark on the stream (their
+    schema views are slotted), and every materialized batch routes the
+    column wide — x == 0.1 matches exactly out-of-core too."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import Compliance
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.streaming import stream_table
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    vals = np.array([0.1, 0.2, 0.3, 0.1, 5.0, 1 / 3] * 500)
+    t = ColumnarTable([Column("x", DType.FRACTIONAL, values=vals)])
+    st = stream_table(t, batch_rows=700)  # multiple uneven batches
+    ctx = AnalysisRunner.do_analysis_run(st, [Compliance("eq", "x == 0.1")])
+    assert ctx.metric_map[Compliance("eq", "x == 0.1")].value.get() == 2 / 6
