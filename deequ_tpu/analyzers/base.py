@@ -167,12 +167,13 @@ class Analyzer(ABC):
     def compute_state_from_stream(self, stream) -> Optional[State]:
         """Out-of-core state: fold the monoid per batch — the same
         ``State.sum`` merge used across devices and incremental runs,
-        applied across stream batches. Scan-shareable analyzers override
-        this (the fused scan engine streams them in one pipelined pass)."""
-        state: Optional[State] = None
+        applied across stream batches as a TREE (StreamStateFolder).
+        Scan-shareable analyzers override this (the fused scan engine
+        streams them in one pipelined pass)."""
+        folder = StreamStateFolder()
         for batch in stream.batches(columns=self._stream_columns()):
-            state = merge_states(state, self.compute_state_from(batch))
-        return state
+            folder.add(self.compute_state_from(batch))
+        return folder.result()
 
     def _stream_columns(self) -> Optional[List[str]]:
         """Columns to read when streaming (None = all); overridden by
@@ -223,6 +224,39 @@ def merge_states(a: Optional[State], b: Optional[State]) -> Optional[State]:
     if a is not None and b is not None:
         return a.sum(b)
     return a if a is not None else b
+
+
+class StreamStateFolder:
+    """Mergesort-style TREE fold of monoid states across stream batches.
+
+    A linear chain (``merged = merged.sum(batch_state)``) re-merges the
+    full growing state per batch — for frequency states that is
+    O(B * G log G) and measured HOURS at 100 batches / 33M groups. The
+    tree (a binary-counter stack of power-of-two partials) merges each
+    state O(log B) times instead — the streaming analogue of the
+    reference's treeReduce (KLLRunner.scala:104-112). States whose merge
+    is set-like (frequency tables: re-sorted by key every merge) are
+    bit-identical under any association; scalar float states differ only
+    at the ulp level, the same variation any distributed fold has."""
+
+    def __init__(self):
+        self._stack: list = []  # (level, state); levels strictly decrease toward the top
+
+    def add(self, state: Optional[State]) -> None:
+        if state is None:  # all-null batches contribute no state
+            return
+        level = 0
+        while self._stack and self._stack[-1][0] == level:
+            _, prev = self._stack.pop()
+            state = prev.sum(state)
+            level += 1
+        self._stack.append((level, state))
+
+    def result(self) -> Optional[State]:
+        merged: Optional[State] = None
+        for _, s in reversed(self._stack):
+            merged = s if merged is None else s.sum(merged)
+        return merged
 
 
 class ScanShareableAnalyzer(Analyzer):

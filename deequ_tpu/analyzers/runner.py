@@ -384,7 +384,7 @@ class AnalysisRunner:
         one full storage scan per analyzer. An analyzer whose per-batch
         update raises drops out with a failure metric; the others keep
         folding."""
-        from deequ_tpu.analyzers.base import merge_states
+        from deequ_tpu.analyzers.base import StreamStateFolder
 
         columns: Optional[set] = set()
         for a in analyzers:
@@ -394,7 +394,11 @@ class AnalysisRunner:
                 break
             columns.update(cols)
 
-        states: Dict[Analyzer, Optional[State]] = {a: None for a in analyzers}
+        # tree fold per analyzer (see StreamStateFolder: a linear chain
+        # re-merges the full growing state per batch)
+        folders: Dict[Analyzer, StreamStateFolder] = {
+            a: StreamStateFolder() for a in analyzers
+        }
         failed: Dict[Analyzer, Exception] = {}
         try:
             for batch in data.batches(
@@ -404,9 +408,7 @@ class AnalysisRunner:
                     if a in failed:
                         continue
                     try:
-                        states[a] = merge_states(
-                            states[a], a.compute_state_from(batch)
-                        )
+                        folders[a].add(a.compute_state_from(batch))
                     except Exception as e:  # noqa: BLE001
                         failed[a] = e
         except Exception as e:  # noqa: BLE001 — a source/read error fails
@@ -424,7 +426,7 @@ class AnalysisRunner:
                 )
             else:
                 ctx.metric_map[a] = a.calculate_metric(
-                    states[a], aggregate_with, save_states_with
+                    folders[a].result(), aggregate_with, save_states_with
                 )
         return ctx
 
@@ -440,14 +442,19 @@ class AnalysisRunner:
 
         # out-of-core: fold the frequency monoid per batch (the same
         # outer-join-sum merge used for incremental states,
-        # GroupingAnalyzers.scala:127-147) — the count-stats fast path
-        # needs global counts, so it does not apply batchwise
+        # GroupingAnalyzers.scala:127-147) as a TREE — see
+        # StreamStateFolder for why a linear chain is ruinous here. The
+        # count-stats fast path needs global counts, so it does not
+        # apply batchwise.
         if getattr(data, "is_streaming", False):
+            from deequ_tpu.analyzers.base import StreamStateFolder
+
             merged: Optional[FrequenciesAndNumRows] = None
             try:
+                folder = StreamStateFolder()
                 for batch in data.batches(columns=grouping_columns):
-                    s = group_counts_state(batch, grouping_columns)
-                    merged = s if merged is None else merged.sum(s)
+                    folder.add(group_counts_state(batch, grouping_columns))
+                merged = folder.result()
             except Exception as e:  # noqa: BLE001
                 wrapped = wrap_if_necessary(e)
                 return AnalyzerContext(
