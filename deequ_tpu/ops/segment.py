@@ -99,15 +99,23 @@ def _device_unique_inverse(
         # integer/bool columns have no NaN; the kernel's v != v is all-False
         values = np.asarray(values)
     sv_dev, starts_dev, inv_dev, nu_dev = _unique_inverse_kernel(values, mask)
-    if n <= SMALL_N_FETCH_LIMIT:
+
+    def single_phase():
         sv, starts, inv = (
             np.asarray(x) for x in (sv_dev, starts_dev, inv_dev)
         )
         _record_fetch(sv, starts, inv)
         return sv[starts], inv
+
+    if n <= SMALL_N_FETCH_LIMIT:
+        return single_phase()
     num_uniques = int(nu_dev)
     SCAN_STATS.bytes_fetched += 8
     size = _pad_group_count(num_uniques)
+    if size >= n:
+        # nearly-all-distinct column: the padded gather fetches more
+        # than the sorted values themselves
+        return single_phase()
     uniques = np.asarray(_gather_at_starts_kernel(sv_dev, starts_dev, size))
     inv = np.asarray(inv_dev)
     _record_fetch(uniques, inv)
@@ -183,20 +191,31 @@ def _device_matrix_rle(
     smat_dev, sva_dev, starts_dev, scalars_dev = _matrix_rle_kernel(
         code_matrix, valid
     )
-    if n <= SMALL_N_FETCH_LIMIT:
-        smat, sva, starts = (
-            np.asarray(x) for x in (smat_dev, sva_dev, starts_dev)
-        )
-        _record_fetch(smat, sva, starts)
-        m = int(sva.sum())  # valid rows occupy the sorted prefix
+
+    def single_phase(m=None):
+        smat, starts = np.asarray(smat_dev), np.asarray(starts_dev)
+        if m is None:
+            sva = np.asarray(sva_dev)
+            _record_fetch(smat, sva, starts)
+            m = int(sva.sum())  # valid rows occupy the sorted prefix
+        else:
+            _record_fetch(smat, starts)
         positions = np.nonzero(starts)[0]
         groups = smat[:, positions]
         counts = np.diff(np.append(positions, m)).astype(np.int64)
         return groups, counts
 
+    if n <= SMALL_N_FETCH_LIMIT:
+        return single_phase()
+
     num_groups, m = (int(x) for x in np.asarray(scalars_dev))
     SCAN_STATS.bytes_fetched += 16
     size = _pad_group_count(num_groups)
+    if size >= n:
+        # nearly-all-distinct data: the pow2-padded gather would fetch
+        # MORE than the plain sorted matrix (up to 2n slots); m is
+        # already known from the scalar fetch
+        return single_phase(m)
     reps, counts = (
         np.asarray(x)
         for x in _rle_gather_kernel(smat_dev, starts_dev, m, size)

@@ -676,3 +676,31 @@ def test_sparse_and_dense_grouping_agree_randomized(monkeypatch):
         assert dense_stats.singletons == sparse_stats.singletons, case
         if dense_stats.num_groups:
             assert abs(dense_stats.entropy - sparse_stats.entropy) < 1e-9
+
+
+def test_sparse_gather_falls_back_when_groups_near_rows(monkeypatch):
+    """Nearly-all-distinct data: the pow2-padded O(G) gather would fetch
+    up to 2n slots, more than the sorted matrix itself — the sparse path
+    then takes the single-phase fetch and must stay correct."""
+    import collections
+
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops import segment
+
+    n = segment.SMALL_N_FETCH_LIMIT + 5_000
+    rng = np.random.default_rng(77)
+    a = rng.permutation(n).astype(np.int64)   # all distinct
+    b = rng.integers(0, 3, n).astype(np.int64)
+    table = ColumnarTable([
+        Column("a", DType.INTEGRAL, values=a),
+        Column("b", DType.INTEGRAL, values=b),
+    ])
+    monkeypatch.setattr(segment, "DENSE_KEYSPACE_LIMIT", 0)  # force sparse
+    state = segment.group_counts_state(table, ["a", "b"])
+    expected = collections.Counter(zip(a.tolist(), b.tolist()))
+    assert state.num_groups == len(expected) == n
+    got = state.as_dict()
+    for key, cnt in list(expected.items())[:50]:
+        assert got[key] == cnt
