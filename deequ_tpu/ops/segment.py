@@ -34,6 +34,12 @@ DENSE_KEYSPACE_LIMIT = 1 << 22
 # the extra device round trip the two-phase O(G) fetch path pays
 SMALL_N_FETCH_LIMIT = 1 << 16
 
+# below this row count grouping work runs entirely on HOST: a tiny input's
+# device pass costs a dispatch+fetch round trip (~0.1s on the tunnel, and
+# still dominated by launch latency on a local chip) for microseconds of
+# host work — the latency-dominated regime of BASELINE config 1
+HOST_GROUP_LIMIT = 1 << 14
+
 
 def _pad_group_count(g: int) -> int:
     """Static gather size for a data-dependent group count: next power of
@@ -94,6 +100,21 @@ def _device_unique_inverse(
     n = len(values)
     if n == 0:
         return np.empty(0, dtype=values.dtype), np.zeros(0, dtype=np.int64)
+    if n <= HOST_GROUP_LIMIT and values.dtype != np.float64:
+        # latency-dominated regime: a tiny input's device sort costs one
+        # dispatch+fetch round trip (~0.1s on the tunnel) for microseconds
+        # of work — run the identical unique/inverse on host. FRACTIONAL
+        # columns stay on the device path at EVERY size: the axon
+        # backend's f64 emulation decodes values a few ulps off the
+        # host's bit-exact ones, so a size-dependent path choice would
+        # make the same value produce two different group keys across
+        # batch sizes (review catch) — consistency beats latency there.
+        vals = values[mask]
+        uniques = np.unique(vals)
+        codes = np.zeros(n, dtype=np.int64)
+        if len(uniques):
+            codes[mask] = np.searchsorted(uniques, vals) + 1
+        return uniques, codes
     SCAN_STATS.device_sort_passes += 1
     if values.dtype != np.float64:
         # integer/bool columns have no NaN; the kernel's v != v is all-False
@@ -186,6 +207,20 @@ def _device_matrix_rle(
     k, n = code_matrix.shape
     if n == 0:
         return code_matrix[:, :0], np.zeros(0, dtype=np.int64)
+    if n <= HOST_GROUP_LIMIT:
+        # latency-dominated regime (see _device_unique_inverse): the same
+        # lexsort + adjacent-compare on host, identical results, zero
+        # device round trips
+        perm = np.lexsort(tuple(code_matrix) + (~valid,))
+        smat = code_matrix[:, perm]
+        sva = valid[perm]
+        neq = np.any(smat[:, 1:] != smat[:, :-1], axis=0)
+        starts = np.concatenate([[True], neq]) & sva
+        m = int(sva.sum())
+        positions = np.nonzero(starts)[0]
+        groups = smat[:, positions]
+        counts = np.diff(np.append(positions, m)).astype(np.int64)
+        return groups, counts
     SCAN_STATS.device_sort_passes += 1
 
     smat_dev, sva_dev, starts_dev, scalars_dev = _matrix_rle_kernel(
@@ -418,6 +453,12 @@ def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
     land in an extra trailing slot that is dropped.
     """
     n = len(keys)
+    if n <= HOST_GROUP_LIMIT:
+        # latency-dominated regime: host bincount (totals are identical —
+        # the mesh merge only re-sums the same rows)
+        slots = np.where(keys >= 0, keys, num_segments)
+        counts = np.bincount(slots, minlength=num_segments + 1)
+        return counts[:num_segments].astype(np.int64)
     n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     padded = max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
     if padded != n:
@@ -618,16 +659,34 @@ def group_top_k(
 
     n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     n = len(codes)
-    padded = max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
-    if padded != n:
-        codes = np.concatenate([codes, np.full(padded - n, -1, dtype=np.int64)])
-
     num_segments = card + 1  # slot 0 = null group
     kk = min(k, num_segments)
-    num_groups, top_counts, top_idx = (
-        np.asarray(x) for x in _topk_fn(num_segments, kk, mesh, nv_code)(codes)
-    )
-    _record_fetch(num_groups, top_counts, top_idx)
+
+    if n <= HOST_GROUP_LIMIT:
+        # latency-dominated regime: counts + top-k on host (identical
+        # ordering: argsort(-counts) stable == top_k's rank order up to
+        # count ties, which are unstable on both sides by contract)
+        slots = np.where(codes >= 0, codes, num_segments)
+        counts = np.bincount(slots, minlength=num_segments + 1)[
+            :num_segments
+        ].astype(np.int64)
+        if nv_code >= 0:
+            counts[nv_code] += counts[0]
+            counts[0] = 0
+        num_groups = int((counts > 0).sum())
+        order = np.argsort(-counts, kind="stable")[:kk]
+        top_idx, top_counts = order, counts[order]
+    else:
+        padded = max(n_dev, ((n + n_dev - 1) // n_dev) * n_dev)
+        if padded != n:
+            codes = np.concatenate(
+                [codes, np.full(padded - n, -1, dtype=np.int64)]
+            )
+        num_groups, top_counts, top_idx = (
+            np.asarray(x)
+            for x in _topk_fn(num_segments, kk, mesh, nv_code)(codes)
+        )
+        _record_fetch(num_groups, top_counts, top_idx)
 
     top = []
     for idx, cnt in zip(top_idx.tolist(), top_counts.tolist()):
@@ -635,6 +694,20 @@ def group_top_k(
             continue
         top.append((None if idx == 0 else decode(idx), int(cnt)))
     return TopKCounts(table.num_rows, int(num_groups), tuple(top))
+
+
+def _count_stats_from_counts(counts: np.ndarray, num_rows: int) -> "CountStats":
+    """Host counts vector -> CountStats (shared by the dense path and the
+    small-input host path so the entropy/singleton definitions cannot
+    drift apart)."""
+    num_groups = int(len(counts))
+    singletons = int((counts == 1).sum())
+    if num_rows > 0 and num_groups > 0:
+        p = counts.astype(np.float64) / num_rows
+        entropy = float(-(p * np.log(p)).sum())
+    else:
+        entropy = float("nan")
+    return CountStats(num_rows, num_groups, singletons, entropy)
 
 
 @dataclass(frozen=True)
@@ -709,15 +782,7 @@ def group_count_stats(
         if any_non_null is not None:
             keys = np.where(any_non_null, keys, -1)
         counts = _device_bincount(keys, keyspace, mesh)
-        counts = counts[counts > 0]
-        num_groups = int(len(counts))
-        singletons = int((counts == 1).sum())
-        if num_rows > 0 and num_groups > 0:
-            p = counts.astype(np.float64) / num_rows
-            entropy = float(-(p * np.log(p)).sum())
-        else:
-            entropy = float("nan")
-        return CountStats(num_rows, num_groups, singletons, entropy)
+        return _count_stats_from_counts(counts[counts > 0], num_rows)
 
     # sparse path: every aggregate reduces ON DEVICE — only four scalars
     # are fetched, regardless of group count (the former implementation
@@ -728,6 +793,11 @@ def group_count_stats(
         if any_non_null is not None
         else np.ones(table.num_rows, dtype=bool)
     )
+    if table.num_rows <= HOST_GROUP_LIMIT:
+        # latency-dominated regime: _device_matrix_rle takes its host
+        # path below this size — derive the stats from its counts
+        _groups, counts = _device_matrix_rle(matrix, valid)
+        return _count_stats_from_counts(counts, num_rows)
     SCAN_STATS.device_sort_passes += 1
     m, num_groups, singletons, clogc = (
         float(x) for x in _rle_stats_kernel(matrix, valid)

@@ -658,6 +658,9 @@ def test_sparse_and_dense_grouping_agree_randomized(monkeypatch):
             names.append(name)
         table = ColumnarTable(cols)
 
+        # force the DEVICE paths (small inputs otherwise take the host
+        # fast path below HOST_GROUP_LIMIT — covered separately below)
+        monkeypatch.setattr(segment, "HOST_GROUP_LIMIT", 0)
         monkeypatch.setattr(segment, "DENSE_KEYSPACE_LIMIT", 1 << 22)
         dense_state = segment.group_counts_state(table, names)
         dense_stats = segment.group_count_stats(table, names)
@@ -676,6 +679,28 @@ def test_sparse_and_dense_grouping_agree_randomized(monkeypatch):
         assert dense_stats.singletons == sparse_stats.singletons, case
         if dense_stats.num_groups:
             assert abs(dense_stats.entropy - sparse_stats.entropy) < 1e-9
+
+        # host fast path (small inputs skip the device entirely) must
+        # agree with both device paths. Rebuild the table from FRESH
+        # Column objects: the memoized _typed_distinct cache on the old
+        # columns would otherwise hand the host run the device-derived
+        # key arrays and mask any decoded-value drift (review catch).
+        fresh = ColumnarTable([
+            Column(c.name, c.dtype, values=getattr(c, "values", None),
+                   mask=getattr(c, "mask", None), codes=getattr(c, "codes", None),
+                   dictionary=getattr(c, "dictionary", None))
+            if c.dtype == DType.STRING else
+            Column(c.name, c.dtype, values=c.values.copy(), mask=c.mask.copy())
+            for c in cols
+        ])
+        monkeypatch.setattr(segment, "HOST_GROUP_LIMIT", 1 << 14)
+        host_state = segment.group_counts_state(fresh, names)
+        host_stats = segment.group_count_stats(fresh, names)
+        assert host_state.as_dict() == dense_state.as_dict(), case
+        assert host_stats.num_groups == dense_stats.num_groups, case
+        assert host_stats.singletons == dense_stats.singletons, case
+        if dense_stats.num_groups:
+            assert abs(host_stats.entropy - dense_stats.entropy) < 1e-9
 
 
 def test_sparse_gather_falls_back_when_groups_near_rows(monkeypatch):
