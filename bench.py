@@ -131,8 +131,16 @@ def main():
     assert SCAN_STATS.bytes_packed == 0, "unexpected host re-transfer"
 
     rows_per_sec = n_rows / wall
+    # floor-normalized telemetry (VERDICT r5 #6): the tunnel's fetch floor
+    # is weather, compute above it is the engine work cross-round history
+    # can actually compare
+    fetch_floor_ms = round(floor * 1000, 2)
+    compute_above_floor_ms = round(max(wall - floor, 0.0) * 1000, 2)
     # execution breakdown to stderr (the driver parses stdout's single line)
     snap = SCAN_STATS.snapshot()
+    # total tunnel traffic both ways: host->device packing (0 on the
+    # resident path, asserted above) + device->host result fetches
+    bytes_shipped = int(snap["bytes_packed"]) + int(snap["bytes_fetched"])
     print(
         f"breakdown: wall={wall:.3f}s dispatch={snap['dispatch_seconds']:.3f}s "
         f"drain_wait={snap['drain_wait_seconds']:.3f}s "
@@ -149,6 +157,9 @@ def main():
                     "value": round(rows_per_sec, 1),
                     "unit": "rows/sec",
                     "vs_baseline": 1.0,
+                    "fetch_floor_ms": fetch_floor_ms,
+                    "compute_above_floor_ms": compute_above_floor_ms,
+                    "bytes_shipped": bytes_shipped,
                 }
             )
         )
@@ -165,6 +176,9 @@ def main():
                 "value": round(rows_per_sec, 1),
                 "unit": "rows/sec",
                 "vs_baseline": round(rows_per_sec / CPU_MEASURED_ROWS_PER_SEC, 3),
+                "fetch_floor_ms": fetch_floor_ms,
+                "compute_above_floor_ms": compute_above_floor_ms,
+                "bytes_shipped": bytes_shipped,
             }
         )
     )

@@ -21,11 +21,46 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# sibling benchmark modules (config_scale_proof's deterministic sources)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _emit(**kwargs):
     print(json.dumps(kwargs), flush=True)
     return kwargs
+
+
+def _fetch_floor_seconds() -> float:
+    """One trivial dispatch+fetch round trip — the hard latency floor any
+    single scan pays on this host<->device tunnel (measured the same way
+    as bench.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    probe = jax.jit(lambda a: a * 2.0)
+    arg = jnp.ones((8,), jnp.float32)
+    np.asarray(probe(arg))  # compile
+    t0 = time.time()
+    np.asarray(probe(arg))
+    return time.time() - t0
+
+
+def _floor_telemetry(wall: float) -> dict:
+    """Floor-normalized fields for the parsed JSON (VERDICT r5 #6):
+    cross-round history compares engine work (compute above the fetch
+    floor, bytes shipped over the tunnel) instead of tunnel weather.
+    Call AFTER the timed section; the caller resets SCAN_STATS at t0."""
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    floor = _fetch_floor_seconds()
+    snap = SCAN_STATS.snapshot()
+    return {
+        "fetch_floor_ms": round(floor * 1000, 2),
+        "compute_above_floor_ms": round(max(wall - floor, 0.0) * 1000, 2),
+        # tunnel traffic both ways: host->device packing + device->host
+        # result fetches (resident configs ship ~only fetches)
+        "bytes_shipped": int(snap["bytes_packed"]) + int(snap["bytes_fetched"]),
+    }
 
 
 def config1():
@@ -44,6 +79,9 @@ def config1():
     )
     suite = VerificationSuite().on_data(table).add_check(check)
     suite.run()  # warmup/compile
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.reset()
     t0 = time.time()
     result = suite.run()
     wall = time.time() - t0
@@ -51,6 +89,7 @@ def config1():
     return _emit(
         config=1, metric="titanic_verification_wall", rows=table.num_rows,
         value=round(wall, 4), unit="seconds", wall_seconds=round(wall, 4),
+        **_floor_telemetry(wall),
     )
 
 
@@ -87,6 +126,9 @@ def config3(n_rows: int):
         pass
     if table.is_persisted:
         AnalysisRunner.do_analysis_run(table, analyzers)
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.reset()
     t0 = time.time()
     ctx = AnalysisRunner.do_analysis_run(table, analyzers)
     wall = time.time() - t0
@@ -96,6 +138,7 @@ def config3(n_rows: int):
         config=3, metric="corr_kll_50col_rows_per_sec", rows=n_rows,
         value=round(n_rows / wall, 1), unit="rows/sec",
         wall_seconds=round(wall, 3), resident=table.is_persisted,
+        **_floor_telemetry(wall),
     )
 
 
@@ -124,6 +167,9 @@ def config4(n_rows: int):
         pass
     if table.is_persisted:
         AnalysisRunner.do_analysis_run(table, analyzers)
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.reset()
     t0 = time.time()
     ctx = AnalysisRunner.do_analysis_run(table, analyzers)
     wall = time.time() - t0
@@ -136,6 +182,7 @@ def config4(n_rows: int):
         config=4, metric="hll_histogram_highcard_rows_per_sec", rows=n_rows,
         value=round(n_rows / wall, 1), unit="rows/sec",
         wall_seconds=round(wall, 3), resident=table.is_persisted,
+        **_floor_telemetry(wall),
     )
 
 
@@ -175,6 +222,9 @@ def config5_from_disk(n_batches: int, batch_rows: int, tmpdir: str = "/tmp"):
         analyzers = [Size(), Mean("v"), StandardDeviation("v")]
         repo = InMemoryMetricsRepository()
         states = InMemoryStateProvider()
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+        SCAN_STATS.reset()
         t0 = time.time()
         for b, path in enumerate(paths):
             ctx = AnalysisRunner.do_analysis_run(
@@ -193,6 +243,7 @@ def config5_from_disk(n_batches: int, batch_rows: int, tmpdir: str = "/tmp"):
         config=5, metric="incremental_disk_stream_rows_per_sec", rows=total,
         value=round(total / wall, 1), unit="rows/sec",
         wall_seconds=round(wall, 3), batches=n_batches,
+        **_floor_telemetry(wall),
     )
 
 
@@ -263,6 +314,9 @@ def config5(
             )
         batches.append(ColumnarTable(cols))
 
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.reset()
     t0 = time.time()
     if pipelined:
         stream = IncrementalAnalysisStream(
@@ -303,6 +357,146 @@ def config5(
         value=round(total / wall, 1), unit="rows/sec",
         wall_seconds=round(wall, 3), batches=n_batches,
         anomalies=len(result.anomalies),
+        **_floor_telemetry(wall),
+    )
+
+
+def _spill_proof_analyzers():
+    from deequ_tpu.analyzers import ApproxCountDistinct, Histogram, Uniqueness
+
+    return [
+        ApproxCountDistinct("key"),
+        Histogram("key", max_detail_bins=100),
+        Uniqueness(("key",)),
+    ]
+
+
+def _spill_proof_metrics(ctx, analyzers) -> dict:
+    """Comparable (JSON-stable) projection of the config-4 metrics:
+    histogram compares bin count + the full top-N detail, exactly."""
+    acd = ctx.metric_map[analyzers[0]].value.get()
+    hist = ctx.metric_map[analyzers[1]].value.get()
+    uniq = ctx.metric_map[analyzers[2]].value.get()
+    return {
+        "approx_count_distinct": acd,
+        "histogram_bins": hist.number_of_bins,
+        "histogram_top": sorted(
+            (k, v.absolute) for k, v in hist.values.items()
+        ),
+        "uniqueness": uniq,
+    }
+
+
+def spill_proof_child(n_rows: int, budget_bytes: int):
+    """The budgeted run, in ITS OWN process so ru_maxrss is a clean
+    measurement of the spilling path (invoked by spill_proof below)."""
+    import resource
+
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.streaming import StreamingTable
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.states import InMemoryStateProvider
+
+    from config_scale_proof import string_source
+
+    analyzers = _spill_proof_analyzers()
+    source = string_source(
+        n_rows, batch_rows=1_000_000, row_offset=0, seed=400,
+        global_card=max(n_rows // 3, 1),
+    )
+    t0 = time.time()
+    ctx = AnalysisRunner.do_analysis_run(
+        StreamingTable(source), analyzers,
+        save_states_with=InMemoryStateProvider(),
+        group_memory_budget=budget_bytes,
+    )
+    wall = time.time() - t0
+    out = _spill_proof_metrics(ctx, analyzers)
+    out.update(
+        wall_seconds=round(wall, 1),
+        peak_rss_mb=round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1
+        ),
+        spill_runs=SCAN_STATS.spill_runs,
+        spill_merge_passes=SCAN_STATS.spill_merge_passes,
+        spill_bytes_written=SCAN_STATS.spill_bytes_written,
+        spill_bytes_read=SCAN_STATS.spill_bytes_read,
+        peak_group_state_bytes=SCAN_STATS.peak_group_state_bytes,
+    )
+    print(json.dumps(out), flush=True)
+
+
+def spill_proof(n_rows: int, budget_bytes: int, rss_cap_mb: float):
+    """The ISSUE-1 acceptance proof: a config-4 shaped high-cardinality
+    grouping under a hard group memory budget completes within the RSS
+    cap AND produces metrics byte-identical to the unbounded in-RAM path
+    (which runs in THIS process, whose RSS is not under test). Wire-in:
+    ``python benchmarks/run_configs.py --spill-proof [--rows N]``."""
+    import subprocess
+
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.streaming import StreamingTable
+
+    from config_scale_proof import string_source
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.run(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--spill-proof-child", "--rows", str(n_rows),
+            "--budget-bytes", str(budget_bytes),
+        ],
+        capture_output=True, text=True, env=env,
+    )
+    assert child.returncode == 0, child.stderr[-3000:]
+    got = json.loads(child.stdout.strip().splitlines()[-1])
+    # echo the child's stats before asserting so a cap failure still
+    # records what the budgeted run measured
+    print(json.dumps({"spill_proof_child": got}), flush=True)
+    assert got["spill_runs"] >= 1, "budget did not force spilling"
+    assert got["peak_group_state_bytes"] <= budget_bytes, got
+    assert got["peak_rss_mb"] <= rss_cap_mb, (
+        f"budgeted run RSS {got['peak_rss_mb']}MB exceeds cap {rss_cap_mb}MB"
+    )
+
+    # unbounded in-RAM reference over the IDENTICAL deterministic stream.
+    # A process-wide DEEQU_TPU_GROUP_MEMORY_BUDGET would make the
+    # reference spill too (spill-vs-spill proves nothing) — strip it;
+    # the child got its budget via an explicit --budget-bytes.
+    os.environ.pop("DEEQU_TPU_GROUP_MEMORY_BUDGET", None)
+    analyzers = _spill_proof_analyzers()
+    t0 = time.time()
+    ref_ctx = AnalysisRunner.do_analysis_run(
+        StreamingTable(string_source(
+            n_rows, batch_rows=1_000_000, row_offset=0, seed=400,
+            global_card=max(n_rows // 3, 1),
+        )),
+        analyzers,
+    )
+    ref_wall = time.time() - t0
+    ref = _spill_proof_metrics(ref_ctx, analyzers)
+    mismatch = {
+        k: (got[k], ref[k])
+        for k in ref
+        if (got[k] if k != "histogram_top" else [
+            tuple(t) for t in got[k]
+        ]) != ref[k]
+    }
+    assert not mismatch, f"spill vs in-RAM metrics differ: {mismatch}"
+    return _emit(
+        metric="spill_proof_config4_shape", rows=n_rows,
+        budget_bytes=budget_bytes, rss_cap_mb=rss_cap_mb,
+        value=got["peak_rss_mb"], unit="MB_peak_rss",
+        wall_seconds=got["wall_seconds"],
+        unbounded_wall_seconds=round(ref_wall, 1),
+        spill_runs=got["spill_runs"],
+        spill_merge_passes=got["spill_merge_passes"],
+        spill_bytes_written=got["spill_bytes_written"],
+        spill_bytes_read=got["spill_bytes_read"],
+        peak_group_state_bytes=got["peak_group_state_bytes"],
+        metrics_byte_identical=True,
+        histogram_bins=got["histogram_bins"],
+        uniqueness=got["uniqueness"],
     )
 
 
@@ -311,7 +505,31 @@ def main():
     ap.add_argument("--config", type=int, default=None)
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument(
+        "--spill-proof", action="store_true",
+        help="RSS-budget regression proof: high-cardinality grouping "
+        "under a hard budget, metrics byte-identical to in-RAM",
+    )
+    ap.add_argument("--spill-proof-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--budget-bytes", type=int, default=None)
+    ap.add_argument("--rss-cap-mb", type=float, default=2048.0)
     args = ap.parse_args()
+
+    if args.spill_proof_child:
+        spill_proof_child(
+            args.rows or 4_000_000, args.budget_bytes or (64 << 20)
+        )
+        return
+    if args.spill_proof:
+        rows = args.rows or 4_000_000
+        # default budget scales with the workload's group state
+        # (~180B/group at cardinality rows/3) so small proofs still spill
+        budget = args.budget_bytes or max(
+            16 << 20, min(int(rows / 3 * 60), 768 << 20)
+        )
+        spill_proof(rows, budget, args.rss_cap_mb)
+        return
 
     runners = {
         1: lambda: config1(),

@@ -237,13 +237,26 @@ class StreamStateFolder:
     reference's treeReduce (KLLRunner.scala:104-112). States whose merge
     is set-like (frequency tables: re-sorted by key every merge) are
     bit-identical under any association; scalar float states differ only
-    at the ulp level, the same variation any distributed fold has."""
+    at the ulp level, the same variation any distributed fold has.
 
-    def __init__(self):
+    With ``spill_store`` set (a spill.SpillingFrequencyStore), states
+    route into the store instead: the store runs its own tree fold under
+    a byte budget and spills sorted runs to disk past it, so the fold's
+    host memory stays bounded even when the merged state itself is not
+    (high-cardinality frequency tables). ``assume_canonical`` asserts
+    every added state is already in canonical key order (letting the
+    store's flushes skip a re-sort)."""
+
+    def __init__(self, spill_store=None, assume_canonical: bool = False):
         self._stack: list = []  # (level, state); levels strictly decrease toward the top
+        self._spill_store = spill_store
+        self._assume_canonical = assume_canonical
 
     def add(self, state: Optional[State]) -> None:
         if state is None:  # all-null batches contribute no state
+            return
+        if self._spill_store is not None:
+            self._spill_store.add(state, canonical=self._assume_canonical)
             return
         level = 0
         while self._stack and self._stack[-1][0] == level:
@@ -253,6 +266,8 @@ class StreamStateFolder:
         self._stack.append((level, state))
 
     def result(self) -> Optional[State]:
+        if self._spill_store is not None:
+            return self._spill_store.result()
         merged: Optional[State] = None
         for _, s in reversed(self._stack):
             merged = s if merged is None else s.sum(merged)

@@ -96,6 +96,14 @@ def _column_from_cells(cells: list):
 _CANONICAL_NAN = float("nan")
 
 
+def _is_spilled(state) -> bool:
+    """Disk-backed frequency state (spill engine)? Lazy import: grouping
+    is imported by spill.store for FrequenciesAndNumRows."""
+    from deequ_tpu.spill.store import SpilledFrequencies
+
+    return isinstance(state, SpilledFrequencies)
+
+
 class FrequenciesAndNumRows(State):
     """Group frequencies + total row count (at least one grouping column
     non-null). Merge = add counts across the union of groups.
@@ -194,6 +202,11 @@ class FrequenciesAndNumRows(State):
         return codes
 
     def sum(self, other: "FrequenciesAndNumRows") -> "FrequenciesAndNumRows":
+        if _is_spilled(other):
+            # the monoid is commutative and SpilledFrequencies.sum handles
+            # both directions — delegate instead of touching key arrays a
+            # disk-backed state does not materialize
+            return other.sum(self)
         if self.columns != other.columns:
             raise ValueError(
                 f"cannot merge frequency states over different columns: "
@@ -316,6 +329,35 @@ class FrequencyBasedAnalyzer(Analyzer):
     def compute_state_from(self, table: ColumnarTable) -> Optional[FrequenciesAndNumRows]:
         return group_counts_state(table, self.group_columns)
 
+    def compute_state_from_stream(self, stream):
+        """Per-batch frequency fold with optional disk spilling: when the
+        stream carries a group memory budget
+        (``StreamingTable.with_group_memory_budget``), per-batch states
+        emit as canonical sorted deltas and fold into a
+        ``SpillingFrequencyStore`` — host RSS stays bounded by
+        max(budget, one batch's delta) no matter how many distinct groups
+        the stream holds."""
+        from deequ_tpu.analyzers.base import StreamStateFolder
+        from deequ_tpu.spill import SpillingFrequencyStore, resolve_group_budget
+
+        budget = resolve_group_budget(stream)
+        store = (
+            SpillingFrequencyStore(tuple(self.group_columns), budget)
+            if budget is not None
+            else None
+        )
+        folder = StreamStateFolder(
+            spill_store=store, assume_canonical=store is not None
+        )
+        for batch in stream.batches(columns=self._stream_columns()):
+            folder.add(self._batch_state(batch, canonicalize=store is not None))
+        return folder.result()
+
+    def _batch_state(self, batch: ColumnarTable, canonicalize: bool = False):
+        return group_counts_state(
+            batch, self.group_columns, canonicalize=canonicalize
+        )
+
     def _stream_columns(self):
         return list(self.group_columns)
 
@@ -351,6 +393,21 @@ class ScanShareableFrequencyBasedAnalyzer(FrequencyBasedAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException(f"Empty state for analyzer {self!r}.")
             )
+        if _is_spilled(state):
+            # disk-backed state: concrete subclasses are functions of the
+            # count distribution, which streams off the merged runs as
+            # cached CountStats (ONE disk pass shared by all analyzers of
+            # the grouping) — the full frequency table never materializes.
+            # Gated on an explicit override, same as the runner's
+            # count-stats fast path: a subclass that only implements
+            # compute_from_frequencies gets the materialized table instead
+            # of a swallowed NotImplementedError
+            if (
+                type(self).compute_from_count_stats
+                is not ScanShareableFrequencyBasedAnalyzer.compute_from_count_stats
+            ):
+                return self.metric_from_count_stats(state.count_stats())
+            state = state.to_frequencies()
         try:
             value = self.compute_from_frequencies(state)
         except Exception as e:  # noqa: BLE001
@@ -539,6 +596,14 @@ class MutualInformation(FrequencyBasedAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException(f"Empty state for analyzer {self!r}.")
             )
+        if _is_spilled(state):
+            try:
+                mi = self._mi_from_blocks(state)
+            except Exception as e:  # noqa: BLE001
+                return self.to_failure_metric(e)
+            return metric_from_value(
+                mi, "MutualInformation", self.instance, Entity.MULTICOLUMN
+            )
         # vectorized over the columnar joint table: factorize each key
         # column to dense codes, marginals via bincount, one fused log
         # expression — no per-group python objects, so MI over millions of
@@ -555,6 +620,55 @@ class MutualInformation(FrequencyBasedAnalyzer):
         pxy = c / total
         mi = float(np.sum(pxy * np.log(pxy / (px * py))))
         return metric_from_value(mi, "MutualInformation", self.instance, Entity.MULTICOLUMN)
+
+    @staticmethod
+    def _mi_from_blocks(state) -> float:
+        """MI over a spilled joint table in two streaming passes: pass 1
+        accumulates the per-column marginals (dict of distinct value ->
+        count — memory O(|A| + |B|), the joint's G never materializes),
+        pass 2 folds the pxy*log(pxy/(px*py)) terms per block. Float sums
+        associate blockwise, so values match the in-RAM path to ulp-level
+        (the same caveat any distributed fold carries)."""
+        total = state.num_rows
+        marginals: List[Dict[object, int]] = [{}, {}]
+        for kv, kn, counts in state.blocks():
+            for side in (0, 1):
+                valid = ~kn[side]
+                if not valid.any():
+                    continue
+                vals = kv[side][valid]
+                if vals.dtype.kind == "f":
+                    uniq, inv = np.unique(
+                        vals, return_inverse=True, equal_nan=True
+                    )
+                else:
+                    uniq, inv = np.unique(vals, return_inverse=True)
+                sums = np.bincount(
+                    inv.reshape(-1), weights=counts[valid].astype(np.float64)
+                )
+                m = marginals[side]
+                for v, c in zip(uniq.tolist(), sums.tolist()):
+                    if isinstance(v, float) and v != v:
+                        v = _CANONICAL_NAN  # nan != nan breaks dict keys
+                    m[v] = m.get(v, 0) + int(c)
+        mi = 0.0
+        for kv, kn, counts in state.blocks():
+            valid = ~(kn[0] | kn[1])
+            if not valid.any():
+                continue
+            a_cells = [
+                _CANONICAL_NAN if isinstance(v, float) and v != v else v
+                for v in kv[0][valid].tolist()
+            ]
+            b_cells = [
+                _CANONICAL_NAN if isinstance(v, float) and v != v else v
+                for v in kv[1][valid].tolist()
+            ]
+            px = np.array([marginals[0][v] for v in a_cells], np.float64) / total
+            py = np.array([marginals[1][v] for v in b_cells], np.float64) / total
+            pxy = counts[valid].astype(np.float64) / total
+            mi += float(np.sum(pxy * np.log(pxy / (px * py))))
+        return mi
 
     def to_failure_metric(self, exception: Exception) -> DoubleMetric:
         return metric_from_failure(
@@ -687,6 +801,12 @@ class Histogram(FrequencyBasedAnalyzer):
             counts, total_count,
         )
 
+    def _batch_state(self, batch, canonicalize: bool = False):
+        # Histogram's own state builder (stringified labels, all-rows
+        # num_rows) already emits np.unique-sorted keys — canonical order
+        # for free, so spilling folds it without a re-sort
+        return self.compute_state_from(batch)
+
     def calculate(self, table, aggregate_with=None, save_states_with=None):
         # device top-N fast path: when nobody needs the mergeable frequency
         # state and there is no binning UDF, counts are ranked ON DEVICE
@@ -746,6 +866,8 @@ class Histogram(FrequencyBasedAnalyzer):
             return self.to_failure_metric(
                 EmptyStateException(f"Empty state for analyzer {self!r}.")
             )
+        if _is_spilled(state):
+            return self._metric_from_blocks(state)
 
         def build() -> Distribution:
             # top-N by count via argsort over the counts VECTOR; only the
@@ -780,6 +902,45 @@ class Histogram(FrequencyBasedAnalyzer):
                     int(counts[g]), int(counts[g]) / state.num_rows
                 )
             return Distribution(details, number_of_bins=state.num_groups)
+
+        from deequ_tpu.tryresult import Try
+
+        return HistogramMetric(self.column, Try.of(build))
+
+    def _metric_from_blocks(self, state) -> HistogramMetric:
+        """Top-N over a spilled state's streamed blocks. Streaming
+        truncation under the total order (count desc, stringified key asc)
+        is exact — top-N of a union is the top-N of the candidates' union —
+        and selects the SAME bin set as the in-RAM path (which takes all
+        groups above the boundary count and breaks boundary ties by
+        stringified key), so the resulting Distribution is identical."""
+
+        def build() -> Distribution:
+            k = self.max_detail_bins
+            best = None  # (counts, strkeys, values, nulls), size <= k
+            total_bins = 0
+            for kv, kn, counts in state.blocks():
+                total_bins += len(counts)
+                # the same str(cell) order the in-RAM boundary tie-break
+                # uses (np's dragon4 float repr matches python str)
+                strk = np.where(kn[0], "None", kv[0].astype(np.str_))
+                cand = (counts, strk, kv[0], kn[0])
+                if best is not None:
+                    cand = tuple(
+                        np.concatenate([b, c]) for b, c in zip(best, cand)
+                    )
+                # np.lexsort: LAST key is primary -> count desc, key asc
+                order = np.lexsort((cand[1], -cand[0]))[:k]
+                best = tuple(a[order] for a in cand)
+            details = {}
+            if best is not None:
+                counts, _strk, values, nulls = best
+                for g in range(len(counts)):
+                    cell = _cell_to_python(values[g], bool(nulls[g]))
+                    details[cell] = DistributionValue(
+                        int(counts[g]), int(counts[g]) / state.num_rows
+                    )
+            return Distribution(details, number_of_bins=total_bins)
 
         from deequ_tpu.tryresult import Try
 

@@ -129,7 +129,14 @@ class AnalysisRunner:
         reuse_existing_results_for_key=None,
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key=None,
+        group_memory_budget=None,
     ) -> AnalyzerContext:
+        """``group_memory_budget`` (bytes; also settable per-table via
+        ``StreamingTable.with_group_memory_budget`` or the
+        DEEQU_TPU_GROUP_MEMORY_BUDGET env var) bounds the host RSS of
+        grouping-state accumulation: past the budget, frequency deltas
+        spill to disk as sorted runs and merge back streaming at finalize
+        (deequ_tpu/spill). None = unbounded, the historical behavior."""
         if not analyzers:
             return AnalyzerContext.empty()
 
@@ -184,10 +191,36 @@ class AnalysisRunner:
         own_ctx = AnalyzerContext.empty()
         if own_pass and getattr(data, "is_streaming", False):
             own_ctx += AnalysisRunner._run_own_pass_streaming(
-                data, own_pass, aggregate_with, save_states_with
+                data, own_pass, aggregate_with, save_states_with,
+                group_memory_budget=group_memory_budget,
             )
-        else:
+        elif own_pass:
+            # budgeted in-memory table: frequency-shaped own-pass states
+            # (Histogram) are O(#distinct) like the shared grouping path —
+            # slice the rows into budget-sized batches and take the
+            # spilling stream fold, same as _run_grouping_analyzers does
+            from deequ_tpu.spill import budget_batch_rows, resolve_group_budget
+
+            budget = resolve_group_budget(data, group_memory_budget)
+            spillable: list = []
+            if budget is not None:
+                batch_rows = budget_batch_rows(budget)
+                if data.num_rows > batch_rows:
+                    spillable = [
+                        a for a in own_pass
+                        if isinstance(a, FrequencyBasedAnalyzer)
+                    ]
+            if spillable:
+                from deequ_tpu.data.streaming import stream_table
+
+                own_ctx += AnalysisRunner._run_own_pass_streaming(
+                    stream_table(data, batch_rows), spillable,
+                    aggregate_with, save_states_with,
+                    group_memory_budget=budget,
+                )
             for analyzer in own_pass:
+                if analyzer in spillable:
+                    continue
                 own_ctx.metric_map[analyzer] = analyzer.calculate(
                     data, aggregate_with, save_states_with
                 )
@@ -201,7 +234,8 @@ class AnalysisRunner:
             by_grouping.setdefault(key, []).append(analyzer)
         for group_key, group_analyzers in by_grouping.items():
             group_ctx += AnalysisRunner._run_grouping_analyzers(
-                data, list(group_key), group_analyzers, aggregate_with, save_states_with
+                data, list(group_key), group_analyzers, aggregate_with,
+                save_states_with, group_memory_budget=group_memory_budget,
             )
 
         result = (
@@ -378,13 +412,18 @@ class AnalysisRunner:
         analyzers: Sequence[Analyzer],
         aggregate_with=None,
         save_states_with=None,
+        group_memory_budget=None,
     ) -> AnalyzerContext:
         """Fold every own-pass analyzer's monoid state over ONE shared pass
         of the stream (reading the columns any of them needs), instead of
         one full storage scan per analyzer. An analyzer whose per-batch
         update raises drops out with a failure metric; the others keep
-        folding."""
+        folding. Frequency-shaped states (Histogram) spill to disk under a
+        group memory budget like the shared-grouping path."""
         from deequ_tpu.analyzers.base import StreamStateFolder
+        from deequ_tpu.spill import resolve_group_budget
+
+        budget = resolve_group_budget(data, group_memory_budget)
 
         columns: Optional[set] = set()
         for a in analyzers:
@@ -394,10 +433,24 @@ class AnalysisRunner:
                 break
             columns.update(cols)
 
+        def make_folder(a: Analyzer) -> StreamStateFolder:
+            if budget is not None and isinstance(a, FrequencyBasedAnalyzer):
+                from deequ_tpu.spill import SpillingFrequencyStore
+
+                return StreamStateFolder(
+                    spill_store=SpillingFrequencyStore(
+                        tuple(a.group_columns), budget
+                    ),
+                    # Histogram states are np.unique-label-sorted; shared
+                    # grouping states don't come through this path
+                    assume_canonical=True,
+                )
+            return StreamStateFolder()
+
         # tree fold per analyzer (see StreamStateFolder: a linear chain
         # re-merges the full growing state per batch)
         folders: Dict[Analyzer, StreamStateFolder] = {
-            a: StreamStateFolder() for a in analyzers
+            a: make_folder(a) for a in analyzers
         }
         failed: Dict[Analyzer, Exception] = {}
         try:
@@ -437,23 +490,44 @@ class AnalysisRunner:
         analyzers: Sequence[FrequencyBasedAnalyzer],
         aggregate_with=None,
         save_states_with=None,
+        group_memory_budget=None,
     ) -> AnalyzerContext:
         from deequ_tpu.ops.segment import group_count_stats, group_counts_state
+        from deequ_tpu.spill import resolve_group_budget
+
+        budget = resolve_group_budget(data, group_memory_budget)
 
         # out-of-core: fold the frequency monoid per batch (the same
         # outer-join-sum merge used for incremental states,
         # GroupingAnalyzers.scala:127-147) as a TREE — see
-        # StreamStateFolder for why a linear chain is ruinous here. The
-        # count-stats fast path needs global counts, so it does not
-        # apply batchwise.
+        # StreamStateFolder for why a linear chain is ruinous here. Under
+        # a group memory budget the fold routes through the spill store:
+        # per-batch states emit as canonical sorted deltas, the tail
+        # spills to sorted runs past the budget, and metric math streams
+        # the k-way merge at finalize (deequ_tpu/spill). The count-stats
+        # fast path needs global counts, so it does not apply batchwise.
         if getattr(data, "is_streaming", False):
             from deequ_tpu.analyzers.base import StreamStateFolder
 
-            merged: Optional[FrequenciesAndNumRows] = None
+            merged: Optional[State] = None
             try:
-                folder = StreamStateFolder()
+                store = None
+                if budget is not None:
+                    from deequ_tpu.spill import SpillingFrequencyStore
+
+                    store = SpillingFrequencyStore(
+                        tuple(grouping_columns), budget
+                    )
+                folder = StreamStateFolder(
+                    spill_store=store, assume_canonical=store is not None
+                )
                 for batch in data.batches(columns=grouping_columns):
-                    folder.add(group_counts_state(batch, grouping_columns))
+                    folder.add(
+                        group_counts_state(
+                            batch, grouping_columns,
+                            canonicalize=store is not None,
+                        )
+                    )
                 merged = folder.result()
             except Exception as e:  # noqa: BLE001
                 wrapped = wrap_if_necessary(e)
@@ -503,6 +577,22 @@ class AnalysisRunner:
             return AnalyzerContext(
                 {a: a.metric_from_count_stats(stats) for a in analyzers}
             )
+
+        # budgeted in-memory table about to MATERIALIZE its frequency
+        # table (state persistence or a non-count-stats analyzer): slice
+        # the rows into batches sized to the budget and take the spilling
+        # fold above — the in-RAM grouping state stays budget-bounded
+        if budget is not None:
+            from deequ_tpu.data.streaming import stream_table
+            from deequ_tpu.spill import budget_batch_rows
+
+            batch_rows = budget_batch_rows(budget)
+            if data.num_rows > batch_rows:
+                return AnalysisRunner._run_grouping_analyzers(
+                    stream_table(data, batch_rows), grouping_columns,
+                    analyzers, aggregate_with, save_states_with,
+                    group_memory_budget=budget,
+                )
 
         try:
             state: Optional[State] = group_counts_state(data, grouping_columns)

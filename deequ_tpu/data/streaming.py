@@ -55,6 +55,7 @@ class StreamingTable:
             List[Tuple[Callable[[ColumnarTable], ColumnarTable], frozenset]]
         ] = None,
         schema_override: Optional[Schema] = None,
+        group_memory_budget: Optional[int] = None,
     ):
         # each transform is (fn, input_columns): the inputs are added to
         # column-pruned reads so transforms keep working without forcing a
@@ -62,6 +63,10 @@ class StreamingTable:
         self.source = source
         self._transforms = list(transforms or [])
         self._schema = schema_override or source.schema
+        # grouping-state RSS bound carried by the data handle (runners and
+        # analyzers read it via spill.resolve_group_budget): frequency
+        # tables spill to sorted disk runs past this many bytes
+        self.group_memory_budget = group_memory_budget
 
     # -- schema surface (everything the planner touches) --------------------
 
@@ -157,6 +162,19 @@ class StreamingTable:
             self.source,
             self._transforms + [(transform, frozenset(casts))],
             Schema(fields),
+            group_memory_budget=self.group_memory_budget,
+        )
+
+    def with_group_memory_budget(self, budget_bytes: int) -> "StreamingTable":
+        """A new handle whose grouping analyses spill frequency state to
+        disk past ``budget_bytes`` of host RAM (deequ_tpu/spill): the
+        out-of-core guarantee extends from O(batch) scan state to the
+        otherwise O(#distinct) frequency tables."""
+        return StreamingTable(
+            self.source,
+            self._transforms,
+            self._schema,
+            group_memory_budget=int(budget_bytes),
         )
 
     # -- materialization guards ----------------------------------------------

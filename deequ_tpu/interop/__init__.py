@@ -19,6 +19,7 @@ from deequ_tpu.interop.deequ_import import (
     import_analysis_results,
     import_repository_json,
     load_reference_state,
+    murmur3_x86_32,
     reference_state_identifier,
     scala_murmur3_string_hash,
 )
@@ -27,6 +28,7 @@ __all__ = [
     "import_analysis_results",
     "import_repository_json",
     "load_reference_state",
+    "murmur3_x86_32",
     "reference_state_identifier",
     "scala_murmur3_string_hash",
 ]

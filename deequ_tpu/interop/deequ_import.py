@@ -67,6 +67,24 @@ def _fmix(h: int) -> int:
     return h ^ (h >> 16)
 
 
+def murmur3_x86_32(data: bytes, seed: int = 0) -> int:
+    """Canonical MurmurHash3 x86_32 over bytes (Austin Appleby's
+    MurmurHash3.cpp), built from the SAME ``_mix``/``_mix_last``/``_fmix``
+    primitives ``scala_murmur3_string_hash`` uses — Scala's MurmurHash3
+    class implements exactly these constants/rotations, so pinning this
+    function against the published Appleby/SMHasher test vectors
+    (tests/test_interop.py) pins the primitives the state-file identifier
+    hash is wired from. Returns the UNSIGNED 32-bit value."""
+    h = seed & _M32
+    n_blocks = len(data) & ~3
+    for i in range(0, n_blocks, 4):
+        h = _mix(h, int.from_bytes(data[i:i + 4], "little"))
+    tail = data[n_blocks:]
+    if tail:
+        h = _mix_last(h, int.from_bytes(tail, "little"))
+    return _fmix((h ^ len(data)) & _M32)
+
+
 def scala_murmur3_string_hash(s: str, seed: int = 42) -> int:
     """scala.util.hashing.MurmurHash3.stringHash: UTF-16 CODE UNITS
     combined pairwise into one 32-bit word per mix step, trailing unit

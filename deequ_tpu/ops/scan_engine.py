@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from deequ_tpu.data.table import Column, ColumnarTable, DType
 from deequ_tpu.expr.eval import Val
-from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh
+from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh, shard_map
 
 DEFAULT_CHUNK_ROWS = 1 << 20
 # target bytes per packed chunk transfer: large enough to amortize the
@@ -152,6 +152,15 @@ class ScanStats:
         # between scan_seconds and (dispatch + drain_wait) is host packing.
         self.dispatch_seconds = 0.0
         self.drain_wait_seconds = 0.0
+        # out-of-core spill engine (deequ_tpu/spill): sorted runs written,
+        # bytes moved to/from disk, merge cascade passes, and the largest
+        # in-RAM grouping tail observed (the number the group memory
+        # budget bounds)
+        self.spill_runs = 0
+        self.spill_bytes_written = 0
+        self.spill_bytes_read = 0
+        self.spill_merge_passes = 0
+        self.peak_group_state_bytes = 0
 
     def snapshot(self) -> dict:
         return dict(self.__dict__)
@@ -722,7 +731,7 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
         )
 
     if mesh is not None:
-        inner = jax.shard_map(
+        inner = shard_map(
             step,
             mesh=mesh,
             in_specs=(

@@ -25,7 +25,7 @@ from jax.sharding import PartitionSpec as P
 
 from deequ_tpu.data.table import Column, ColumnarTable, DType
 from deequ_tpu.ops.scan_engine import SCAN_STATS
-from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh
+from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh, shard_map
 
 # dense device count vectors are used up to this key-space size
 DENSE_KEYSPACE_LIMIT = 1 << 22
@@ -304,7 +304,7 @@ def _bincount_fn(num_segments: int, mesh):
 
     if mesh is not None:
         return jax.jit(
-            jax.shard_map(count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+            shard_map(count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
         )
     return jax.jit(count)
 
@@ -332,7 +332,7 @@ def _topk_fn(num_segments: int, kk: int, mesh, merge_null_into: int = -1):
 
     if mesh is not None:
         return jax.jit(
-            jax.shard_map(kernel, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+            shard_map(kernel, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
         )
     return jax.jit(kernel)
 
@@ -367,7 +367,7 @@ def _resident_bincount_fn(
     if mesh is not None:
         in_specs = (P(None, ROW_AXIS), P(ROW_AXIS)) * n_chunks
         return jax.jit(
-            jax.shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=P())
+            shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=P())
         )
     return jax.jit(kernel)
 
@@ -488,6 +488,7 @@ def group_counts_state(
     columns: Sequence[str],
     mesh=None,
     require_any_non_null: bool = True,
+    canonicalize: bool = False,
 ):
     """Compute the frequency table for a set of grouping columns as a
     COLUMNAR ``FrequenciesAndNumRows`` (reference
@@ -495,6 +496,17 @@ def group_counts_state(
     keys decode via vectorized gathers into the per-column distinct-value
     arrays — no per-group python loop, so 100M-distinct groupings stay in
     array ops end to end.
+
+    ``canonicalize=True`` emits the state as a SORTED delta in canonical
+    key order (first column most significant, nulls first, values
+    ascending, NaN last — the order ``FrequenciesAndNumRows.sum``
+    produces): the out-of-core spill engine (deequ_tpu/spill) folds these
+    per-chunk sorted deltas straight into budget-bounded runs without
+    re-sorting. Numeric columns come out of the device paths already in
+    that order (codes are value-ascending ranks); string columns carry
+    ingest-dictionary codes in arbitrary dictionary order, so the emitted
+    delta is VERIFIED (O(G) adjacent-row compare) and host sort+dedup'd
+    only when the order actually fails.
     """
     from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
 
@@ -563,6 +575,13 @@ def group_counts_state(
         )
         groups_mat, group_counts_vec = _device_matrix_rle(matrix, valid)
         digit_cols = [groups_mat[i] for i in range(groups_mat.shape[0])]
+        if canonicalize and len(digit_cols) > 1:
+            # the RLE kernels lexsort last-column-major; re-order the O(G)
+            # digit codes first-column-major (digits ARE canonical ranks:
+            # 0 = null, then value-ascending np.unique codes)
+            order = np.lexsort(tuple(reversed(digit_cols)))
+            digit_cols = [d[order] for d in digit_cols]
+            group_counts_vec = group_counts_vec[order]
 
     key_values = []
     key_nulls = []
@@ -573,6 +592,16 @@ def group_counts_state(
         else:
             key_values.append(np.zeros(len(digits), dtype=values.dtype))
         key_nulls.append(nulls)
+    if canonicalize:
+        # lazy import: spill depends on analyzers.grouping which imports
+        # this module; at call time everything is loaded
+        from deequ_tpu.spill.order import is_strictly_ascending, merge_add_sorted
+
+        if not is_strictly_ascending(key_values, key_nulls):
+            kv, kn, group_counts_vec = merge_add_sorted(
+                [(tuple(key_values), tuple(key_nulls), group_counts_vec)]
+            )
+            key_values, key_nulls = list(kv), list(kn)
     return FrequenciesAndNumRows(
         tuple(columns), tuple(key_values), tuple(key_nulls),
         group_counts_vec, num_rows,

@@ -20,6 +20,21 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh
 
+# jax.shard_map graduated from jax.experimental in newer releases (where the
+# replication-check kwarg is also renamed check_rep -> check_vma); older
+# runtimes (e.g. 0.4.x) only ship the experimental symbol. One resolution
+# point here so every kernel site works on both.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on older jax
+
+    def shard_map(f, *args, **kwargs):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _sm(f, *args, **kwargs)
+
 ROW_AXIS = "rows"
 
 _state = threading.local()

@@ -1,0 +1,24 @@
+"""Out-of-core spill engine for high-cardinality grouping states.
+
+Converts the hard OOM cliff of RAM-resident frequency tables into
+graceful disk-backed degradation: deltas fold in RAM under a byte budget,
+spill as canonically sorted binary runs, and merge back k-way at finalize
+as a bounded stream of blocks the metric layer consumes directly. See
+docs/out_of_core_streaming.md ("Spilling grouping state to disk").
+"""
+
+from deequ_tpu.spill.store import (
+    DEFAULT_BUDGET_BYTES,
+    SpilledFrequencies,
+    SpillingFrequencyStore,
+    budget_batch_rows,
+    resolve_group_budget,
+)
+
+__all__ = [
+    "DEFAULT_BUDGET_BYTES",
+    "SpilledFrequencies",
+    "SpillingFrequencyStore",
+    "budget_batch_rows",
+    "resolve_group_budget",
+]

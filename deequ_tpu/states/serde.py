@@ -184,8 +184,82 @@ def _dec_kll(buf: bytes, version: int):
     return KLLState(sketch, gmin, gmax)
 
 
-# columnar key-array kinds (v3 frequency payloads)
+# columnar key-array kinds (v3 frequency payloads + spill run blocks)
 _KCOL_STR, _KCOL_INT, _KCOL_FLOAT, _KCOL_BOOL = range(4)
+
+
+def encode_key_column(values, nulls) -> bytes:
+    """One typed key column (values + null mask) -> bytes: packed nullbits,
+    a kind tag, then the raw array blob. Shared between the v3 frequency
+    payload and the spill engine's sorted-run blocks (deequ_tpu/spill/runs.py)
+    so the two on-disk key encodings cannot drift apart."""
+    import numpy as np
+
+    out = [np.packbits(np.asarray(nulls, dtype=bool)).tobytes()]
+    kind = values.dtype.kind
+    if kind in ("U", "S", "O"):
+        # raw little-endian UCS4 fixed-width block: ~4x the bytes of
+        # utf-8 but encode AND decode are single vectorized buffer
+        # copies — per-group python joins/decodes measured 30x slower
+        # than the whole analysis at 1M groups
+        svals = values.astype(np.str_)
+        width = max(svals.dtype.itemsize // 4, 1)
+        blob = np.ascontiguousarray(svals.astype(f"<U{width}")).tobytes()
+        out.append(bytes([_KCOL_STR]))
+        out.append(_i64.pack(width))
+        out.append(blob)
+    elif values.dtype == np.bool_:
+        out.append(bytes([_KCOL_BOOL]))
+        out.append(np.packbits(values).tobytes())
+    elif kind in "iu":
+        if kind == "u" and len(values) and int(values.max()) >= 2 ** 63:
+            # the wire format is <i8; uint64 keys >= 2^63 would wrap on
+            # round-trip. No constructor produces unsigned key arrays
+            # today, so refuse loudly rather than corrupt silently.
+            raise ValueError(
+                "frequency state has unsigned int group keys >= 2^63; "
+                "the <i8 wire format cannot represent them"
+            )
+        out.append(bytes([_KCOL_INT]))
+        out.append(np.ascontiguousarray(values, dtype="<i8").tobytes())
+    else:
+        out.append(bytes([_KCOL_FLOAT]))
+        out.append(np.ascontiguousarray(values, dtype="<f8").tobytes())
+    return b"".join(out)
+
+
+def decode_key_column(buf: bytes, off: int, G: int):
+    """Inverse of :func:`encode_key_column`. Returns (values, nulls, off)."""
+    import numpy as np
+
+    nbytes_mask = (G + 7) // 8
+    nulls = np.unpackbits(
+        np.frombuffer(buf, dtype=np.uint8, count=nbytes_mask, offset=off),
+        count=G,
+    ).astype(bool)
+    off += nbytes_mask
+    kind = buf[off]; off += 1
+    if kind == _KCOL_STR:
+        (width,) = _i64.unpack_from(buf, off); off += 8
+        values = np.frombuffer(
+            buf, dtype=f"<U{width}", count=G, offset=off
+        ).copy() if G else np.empty(0, dtype=np.str_)
+        off += 4 * width * G
+    elif kind == _KCOL_BOOL:
+        values = np.unpackbits(
+            np.frombuffer(buf, dtype=np.uint8, count=nbytes_mask, offset=off),
+            count=G,
+        ).astype(bool)
+        off += nbytes_mask
+    elif kind == _KCOL_INT:
+        values = np.frombuffer(buf, dtype="<i8", count=G, offset=off).copy()
+        off += 8 * G
+    elif kind == _KCOL_FLOAT:
+        values = np.frombuffer(buf, dtype="<f8", count=G, offset=off).copy()
+        off += 8 * G
+    else:
+        raise ValueError(f"unknown key-column kind {kind}")
+    return values, nulls, off
 
 
 def _enc_freq(state) -> bytes:
@@ -200,36 +274,7 @@ def _enc_freq(state) -> bytes:
     out.append(_i64.pack(G))
     out.append(np.ascontiguousarray(state.counts, dtype="<i8").tobytes())
     for values, nulls in zip(state.key_values, state.key_nulls):
-        out.append(np.packbits(np.asarray(nulls, dtype=bool)).tobytes())
-        kind = values.dtype.kind
-        if kind in ("U", "S", "O"):
-            # raw little-endian UCS4 fixed-width block: ~4x the bytes of
-            # utf-8 but encode AND decode are single vectorized buffer
-            # copies — per-group python joins/decodes measured 30x slower
-            # than the whole analysis at 1M groups
-            svals = values.astype(np.str_)
-            width = max(svals.dtype.itemsize // 4, 1)
-            blob = np.ascontiguousarray(svals.astype(f"<U{width}")).tobytes()
-            out.append(bytes([_KCOL_STR]))
-            out.append(_i64.pack(width))
-            out.append(blob)
-        elif values.dtype == np.bool_:
-            out.append(bytes([_KCOL_BOOL]))
-            out.append(np.packbits(values).tobytes())
-        elif kind in "iu":
-            if kind == "u" and len(values) and int(values.max()) >= 2 ** 63:
-                # the wire format is <i8; uint64 keys >= 2^63 would wrap on
-                # round-trip. No constructor produces unsigned key arrays
-                # today, so refuse loudly rather than corrupt silently.
-                raise ValueError(
-                    "frequency state has unsigned int group keys >= 2^63; "
-                    "the <i8 wire format cannot represent them"
-                )
-            out.append(bytes([_KCOL_INT]))
-            out.append(np.ascontiguousarray(values, dtype="<i8").tobytes())
-        else:
-            out.append(bytes([_KCOL_FLOAT]))
-            out.append(np.ascontiguousarray(values, dtype="<f8").tobytes())
+        out.append(encode_key_column(values, nulls))
     return b"".join(out)
 
 
@@ -301,6 +346,57 @@ def _dec_freq(buf: bytes, version: int):
     )
 
 
+def _enc_spilled(state) -> bytes:
+    """Tag-13 payload: a disk-backed SpilledFrequencies streams out as a
+    header (columns, num_rows, budget) plus length-prefixed sorted blocks
+    — the run-block codec, so nothing materializes the whole table while
+    encoding. A trailing -1 sentinel terminates the block list."""
+    from deequ_tpu.spill.runs import encode_block
+
+    out = [_i64.pack(len(state.columns))]
+    for c in state.columns:
+        out.append(_pack_str(c))
+    out.append(_i64.pack(state.num_rows))
+    out.append(_i64.pack(state._store.budget_bytes))
+    for kv, kn, counts in state.blocks():
+        payload = encode_block(kv, kn, counts)
+        out.append(_i64.pack(len(payload)))
+        out.append(payload)
+    out.append(_i64.pack(-1))
+    return b"".join(out)
+
+
+def _dec_spilled(buf: bytes, version: int):
+    """Rebuilds a disk-backed state: blocks decode straight into a fresh
+    store's run file (they are globally sorted and key-unique by
+    construction), so the loaded state keeps the bounded-RSS contract."""
+    from deequ_tpu.spill.runs import decode_block
+    from deequ_tpu.spill.store import SpilledFrequencies, SpillingFrequencyStore
+
+    off = 0
+    (n_cols,) = _i64.unpack_from(buf, off); off += 8
+    columns = []
+    for _ in range(n_cols):
+        c, off = _unpack_str(buf, off)
+        columns.append(c)
+    (num_rows,) = _i64.unpack_from(buf, off); off += 8
+    (budget,) = _i64.unpack_from(buf, off); off += 8
+    store = SpillingFrequencyStore(tuple(columns), budget)
+
+    def block_iter():
+        nonlocal off
+        while True:
+            (nbytes,) = _i64.unpack_from(buf, off)
+            off += 8
+            if nbytes < 0:
+                return
+            yield decode_block(buf[off:off + nbytes], n_cols)
+            off += nbytes
+
+    store._adopt_sorted_blocks(block_iter(), num_rows)
+    return SpilledFrequencies(store)
+
+
 def _registry() -> Dict[Type[State], Tuple[int, Callable, Callable]]:
     from deequ_tpu.analyzers import grouping, sketches, states
 
@@ -325,6 +421,9 @@ def _registry() -> Dict[Type[State], Tuple[int, Callable, Callable]]:
     add(10, sketches.ApproxCountDistinctState, _enc_hll, _dec_hll)
     add(11, sketches.KLLState, _enc_kll, _dec_kll)
     add(12, grouping.FrequenciesAndNumRows, _enc_freq, _dec_freq)
+    from deequ_tpu.spill.store import SpilledFrequencies
+
+    add(13, SpilledFrequencies, _enc_spilled, _dec_spilled)
     return reg
 
 

@@ -120,6 +120,7 @@ class VerificationSuite:
         save_check_results_json_path: Optional[str] = None,
         save_success_metrics_json_path: Optional[str] = None,
         overwrite_output_files: bool = False,
+        group_memory_budget: Optional[int] = None,
     ) -> VerificationResult:
         analyzers = list(required_analyzers)
         for check in checks:
@@ -134,6 +135,7 @@ class VerificationSuite:
             metrics_repository=metrics_repository,
             reuse_existing_results_for_key=reuse_existing_results_for_key,
             fail_if_results_missing=fail_if_results_missing,
+            group_memory_budget=group_memory_budget,
         )
 
         # evaluate BEFORE appending the new result: anomaly constraints query
@@ -331,6 +333,7 @@ class VerificationRunBuilder:
         self._check_results_path: Optional[str] = None
         self._success_metrics_path: Optional[str] = None
         self._overwrite_output_files = False
+        self._group_memory_budget: Optional[int] = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -354,6 +357,16 @@ class VerificationRunBuilder:
 
     def save_states_with(self, state_persister) -> "VerificationRunBuilder":
         self._save_states_with = state_persister
+        return self
+
+    def with_group_memory_budget(self, budget_bytes: int) -> "VerificationRunBuilder":
+        """Bound the host RSS of grouping-state accumulation (bytes):
+        past the budget, frequency tables spill to disk as sorted runs and
+        stream back at finalize (deequ_tpu/spill), so uniqueness-style
+        checks on high-cardinality columns degrade gracefully instead of
+        OOMing. Surfaced in ScanStats (spill_runs, spill_bytes_written,
+        peak_group_state_bytes)."""
+        self._group_memory_budget = int(budget_bytes)
         return self
 
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
@@ -387,6 +400,7 @@ class VerificationRunBuilder:
             save_check_results_json_path=self._check_results_path,
             save_success_metrics_json_path=self._success_metrics_path,
             overwrite_output_files=self._overwrite_output_files,
+            group_memory_budget=self._group_memory_budget,
         )
 
 

@@ -264,3 +264,154 @@ def test_scala_murmur3_utf16_surrogates_and_null_count_rows(tmp_path):
     (tmp_path / f"s-{ident}-num_rows.bin").write_bytes(struct.pack(">q", 7))
     state = load_reference_state(str(tmp_path / "s"), analyzer)
     assert state.as_dict() == {("a",): 5, ("c",): 2}
+
+
+def test_murmur3_x86_32_published_vectors():
+    """Pin the murmur primitives against the canonical MurmurHash3 x86_32
+    test vectors published for Austin Appleby's reference MurmurHash3.cpp
+    (SMHasher repo) and transcribed in the widely-cited canonical-vector
+    set (see e.g. the cross-implementation suites of pymmh3 and Guava's
+    Murmur3_32HashFunctionTest). Scala's MurmurHash3 implements the same
+    constants/rotations, so these vectors pin the ``_mix``/``_mix_last``/
+    ``_fmix`` wiring the state-file identifier hash is built from."""
+    from deequ_tpu.interop import murmur3_x86_32
+
+    vectors = [
+        # (data, seed, expected unsigned 32-bit)
+        (b"", 0x00000000, 0x00000000),          # empty, zero seed
+        (b"", 0x00000001, 0x514E28B7),          # empty, seed 1
+        (b"", 0xFFFFFFFF, 0x81F16F39),          # empty, all-bits seed
+        (b"\x00\x00\x00\x00", 0x00000000, 0x2362F9DE),  # one zero block
+        (b"\x21\x43\x65\x87", 0x00000000, 0xF55B516B),  # full 4-byte block
+        (b"\x21\x43\x65\x87", 0x5082EDEE, 0x2362F9DE),  # block + seed
+        (b"\x21\x43\x65", 0x00000000, 0x7E4A8634),      # 3-byte tail
+        (b"\x21\x43", 0x00000000, 0xA0F7B07A),          # 2-byte tail
+        (b"\x21", 0x00000000, 0x72661CF4),              # 1-byte tail
+    ]
+    for data, seed, want in vectors:
+        assert murmur3_x86_32(data, seed) == want, (data, hex(seed))
+    # the mmh3 package's README example (signed form): hash("foo") ==
+    # -156908512 with seed 0 over UTF-8 bytes
+    h = murmur3_x86_32(b"foo", 0)
+    assert (h - (1 << 32) if h >= (1 << 31) else h) == -156908512
+
+
+def test_scala_murmur3_composition_from_verified_primitives():
+    """stringHash's wiring, transcribed from the published Scala source
+    (scala/src/library/scala/util/hashing/MurmurHash3.scala, stringHash +
+    finalizeHash): chars combine PAIRWISE as ``(c0 << 16) | c1`` per mix
+    step, a trailing odd char goes through mixLast, and finalizeHash
+    XORs the length in UTF-16 units before the avalanche. With the
+    primitives pinned by the Appleby vectors above, these compositions
+    pin the string path across the length/surrogate edge cases."""
+    from deequ_tpu.interop.deequ_import import _fmix, _mix, _mix_last
+
+    def expect(units, seed):
+        h = seed & 0xFFFFFFFF
+        i = 0
+        while i + 1 < len(units):
+            h = _mix(h, ((units[i] << 16) + units[i + 1]) & 0xFFFFFFFF)
+            i += 2
+        if i < len(units):
+            h = _mix_last(h, units[i])
+        return _fmix((h ^ len(units)) & 0xFFFFFFFF)
+
+    def signed(h):
+        return h - (1 << 32) if h >= (1 << 31) else h
+
+    cases = [
+        ("", []),                                    # len-0 finalize only
+        ("a", [0x61]),                               # lone mixLast char
+        ("ab", [0x61, 0x62]),                        # one full pair block
+        ("abc", [0x61, 0x62, 0x63]),                 # pair + odd tail
+        ("Size(None)", [ord(c) for c in "Size(None)"]),  # even, multi-block
+        ("\U0001D11E", [0xD834, 0xDD1E]),            # surrogate PAIR = 2 units
+        ("\U0001D11Ex", [0xD834, 0xDD1E, 0x78]),     # pair + BMP tail (odd)
+        ("\ud834", [0xD834]),                        # lone surrogate (legal
+                                                     # in a JVM String)
+    ]
+    for s, units in cases:
+        for seed in (42, 0, 1):
+            assert scala_murmur3_string_hash(s, seed) == signed(
+                expect(units, seed)
+            ), (s, seed)
+
+
+def test_frequency_state_multicolumn_mixed_dtypes(tmp_path):
+    """Frequency-table import breadth: a 2-key grouping whose key columns
+    mix STRING and INTEGRAL dtypes (the common country x status_code
+    shape), including a null string key, round-tripped through the
+    reference's Parquet + num_rows.bin layout and on into metric math."""
+    from deequ_tpu.analyzers import CountDistinct, Uniqueness
+    from deequ_tpu.data.io import write_parquet
+    from deequ_tpu.data.table import ColumnarTable
+
+    analyzer = Uniqueness(["cat", "num"])
+    ident = reference_state_identifier(analyzer)
+    freq_table = ColumnarTable.from_pydict({
+        "cat": ["a", "a", "b", None],
+        "num": [1, 2, 1, 3],
+        "absolute": [4, 1, 1, 2],
+    })
+    write_parquet(freq_table, str(tmp_path / f"s-{ident}-frequencies.pqt"))
+    (tmp_path / f"s-{ident}-num_rows.bin").write_bytes(struct.pack(">q", 8))
+
+    state = load_reference_state(str(tmp_path / "s"), analyzer)
+    assert state.columns == ("cat", "num")
+    assert state.num_rows == 8
+    d = state.as_dict()
+    assert d[("a", 1)] == 4
+    assert d[("a", 2)] == 1
+    assert d[("b", 1)] == 1
+    assert d[(None, 3)] == 2
+    # metric math over the imported mixed-dtype state: 3 of 4 groups are
+    # singletons (count == 1 never happens for ("a",1) or (None,3))
+    m = analyzer.compute_metric_from(state)
+    assert m.value.get() == 2 / 8
+    # the same state answers a different count-derived analyzer
+    cd = CountDistinct(["cat", "num"]).compute_metric_from(state)
+    assert cd.value.get() == 4.0
+    # and merges with a natively computed state over the same columns
+    native = ColumnarTable.from_pydict({
+        "cat": ["a", "z"], "num": [1, 9],
+    })
+    from deequ_tpu.ops.segment import group_counts_state
+
+    merged = state.sum(group_counts_state(native, ["cat", "num"]))
+    md = merged.as_dict()
+    assert md[("a", 1)] == 5
+    assert md[("z", 9)] == 1
+    assert merged.num_rows == 10
+
+
+def test_histogram_state_round_trip_compute_metric_from(tmp_path):
+    """A reference-persisted Histogram frequency state (stringified
+    labels, num_rows counts ALL rows) feeds compute_metric_from and
+    yields the exact Distribution the reference would rebuild."""
+    from deequ_tpu.analyzers import Histogram
+    from deequ_tpu.data.io import write_parquet
+    from deequ_tpu.data.table import ColumnarTable
+
+    analyzer = Histogram("cat", max_detail_bins=2)
+    ident = reference_state_identifier(analyzer)
+    freq_table = ColumnarTable.from_pydict({
+        "cat": ["x", "y", "NullValue", "z"],
+        "absolute": [5, 3, 1, 1],
+    })
+    write_parquet(freq_table, str(tmp_path / f"s-{ident}-frequencies.pqt"))
+    (tmp_path / f"s-{ident}-num_rows.bin").write_bytes(struct.pack(">q", 10))
+
+    state = load_reference_state(str(tmp_path / "s"), analyzer)
+    m = analyzer.compute_metric_from(state)
+    dist = m.value.get()
+    assert dist.number_of_bins == 4  # bins count ALL groups, not just top-N
+    assert set(dist.values) == {"x", "y"}  # top max_detail_bins=2 by count
+    assert dist.values["x"].absolute == 5
+    assert dist.values["x"].ratio == 0.5
+    assert dist.values["y"].absolute == 3
+    # and the imported state serializes through the native serde
+    from deequ_tpu.states.serde import deserialize_state, serialize_state
+
+    back = deserialize_state(serialize_state(state))
+    assert back.as_dict() == state.as_dict()
+    assert analyzer.compute_metric_from(back).value.get().values == dist.values
