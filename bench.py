@@ -70,6 +70,63 @@ def build_analyzers():
     return analyzers
 
 
+def measure_checkpoint_overhead(n_rows: int):
+    """Retry/checkpoint cost probe (resilience layer): the same streaming
+    analysis timed plain vs checkpointed-every-4-batches, so the price of
+    host-checkpointable folds shows up in BENCH_*.json as
+    checkpoint_overhead_frac (fraction of plain wall added)."""
+    import shutil
+    import tempfile
+
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.streaming import stream_table
+    from deequ_tpu.resilience import StreamCheckpointer
+
+    table = build_table(n_rows)
+    batch_rows = max(n_rows // 16, 1)
+    analyzers = [Size()]
+    for i in range(4):
+        c = f"c{i}"
+        analyzers += [Completeness(c), Mean(c), Minimum(c), Maximum(c)]
+
+    def run(checkpoint=None):
+        t0 = time.time()
+        ctx = AnalysisRunner.do_analysis_run(
+            stream_table(table, batch_rows),
+            analyzers,
+            checkpoint=checkpoint,
+            # quarantine mode routes the plain run through the same
+            # resilient loop, isolating the checkpoint WRITE cost from
+            # the fold-path difference
+            on_batch_error="skip",
+        )
+        wall = time.time() - t0
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        return wall
+
+    run()  # warmup: compile the per-batch fused program
+    plain = min(run(), run())
+    ckpt_dir = tempfile.mkdtemp(prefix="deequ_bench_ckpt_")
+    try:
+        # fresh checkpointer per rep so `saves` reports ONE run's count
+        # (a completed run clears its directory, so reps don't resume)
+        walls_saves = []
+        for _ in range(2):
+            ck = StreamCheckpointer(ckpt_dir, every_batches=4)
+            walls_saves.append((run(ck), ck.saves))
+        with_ckpt = min(w for w, _ in walls_saves)
+        saves = walls_saves[0][1]
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return {
+        "checkpoint_overhead_frac": round(
+            max(with_ckpt - plain, 0.0) / max(plain, 1e-9), 4
+        ),
+        "checkpoint_saves": saves,
+    }
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -149,6 +206,10 @@ def main():
         f"(v5e HBM peak ~819GB/s)",
         file=sys.stderr,
     )
+    # resilience-layer cost probe (small: 1/50th of the main config)
+    ckpt_probe = measure_checkpoint_overhead(SMOKE_ROWS if smoke else 200_000)
+    print(f"checkpoint probe: {ckpt_probe}", file=sys.stderr)
+
     if smoke:
         print(
             json.dumps(
@@ -160,6 +221,7 @@ def main():
                     "fetch_floor_ms": fetch_floor_ms,
                     "compute_above_floor_ms": compute_above_floor_ms,
                     "bytes_shipped": bytes_shipped,
+                    **ckpt_probe,
                 }
             )
         )
@@ -179,6 +241,7 @@ def main():
                 "fetch_floor_ms": fetch_floor_ms,
                 "compute_above_floor_ms": compute_above_floor_ms,
                 "bytes_shipped": bytes_shipped,
+                **ckpt_probe,
             }
         )
     )

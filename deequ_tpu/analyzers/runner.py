@@ -36,7 +36,10 @@ from deequ_tpu.analyzers.grouping import (
     Histogram,
 )
 from deequ_tpu.data.table import ColumnarTable, Schema
-from deequ_tpu.exceptions import wrap_if_necessary
+from deequ_tpu.exceptions import (
+    MetricCalculationRuntimeException,
+    wrap_if_necessary,
+)
 from deequ_tpu.metrics import DoubleMetric, Metric
 from deequ_tpu.ops.scan_engine import run_scan
 
@@ -48,9 +51,14 @@ class ReusingNotPossibleResultsMissingException(RuntimeError):
 
 @dataclass
 class AnalyzerContext:
-    """Result map Analyzer -> Metric (reference AnalyzerContext.scala:29-105)."""
+    """Result map Analyzer -> Metric (reference AnalyzerContext.scala:29-105).
+
+    ``skipped_batches`` records stream batch indices quarantined by the
+    resilient streaming pass (``on_batch_error="skip"``) — skipped data is
+    REPORTED, never silently dropped (it surfaces on VerificationResult)."""
 
     metric_map: Dict[Analyzer, Metric] = field(default_factory=dict)
+    skipped_batches: List[int] = field(default_factory=list)
 
     @staticmethod
     def empty() -> "AnalyzerContext":
@@ -62,7 +70,10 @@ class AnalyzerContext:
     def __add__(self, other: "AnalyzerContext") -> "AnalyzerContext":
         merged = dict(self.metric_map)
         merged.update(other.metric_map)
-        return AnalyzerContext(merged)
+        skipped = list(self.skipped_batches)
+        seen = set(skipped)
+        skipped += [i for i in other.skipped_batches if i not in seen]
+        return AnalyzerContext(merged, skipped)
 
     def metric(self, analyzer: Analyzer) -> Optional[Metric]:
         return self.metric_map.get(analyzer)
@@ -110,6 +121,29 @@ def _is_grouping_shared(analyzer: Analyzer) -> bool:
     )
 
 
+def _release_spill(folder) -> None:
+    """Free a fold's temp spill directory when its ``result()`` will never
+    run (failed fold / aborted pass) — one copy of the private-attribute
+    poke instead of one per call site."""
+    store = getattr(folder, "_spill_store", None)
+    if store is not None:
+        store.release()
+
+
+def _save_or_append_result(metrics_repository, result_key, ctx) -> None:
+    """Append ctx's metrics into the repository entry for result_key — the
+    ONE copy of the load-combine-save sequence every runner path shares."""
+    if metrics_repository is None or result_key is None:
+        return
+    from deequ_tpu.repository import AnalysisResult
+
+    existing = metrics_repository.load_by_key(result_key)
+    combined = (
+        (existing.analyzer_context + ctx) if existing is not None else ctx
+    )
+    metrics_repository.save(AnalysisResult(result_key, combined))
+
+
 class AnalysisRunner:
     """Entry points for computing metrics (reference AnalysisRunner.scala)."""
 
@@ -130,17 +164,37 @@ class AnalysisRunner:
         fail_if_results_missing: bool = False,
         save_or_append_results_with_key=None,
         group_memory_budget=None,
+        checkpoint=None,
+        on_batch_error: str = "fail",
+        retry_policy=None,
     ) -> AnalyzerContext:
         """``group_memory_budget`` (bytes; also settable per-table via
         ``StreamingTable.with_group_memory_budget`` or the
         DEEQU_TPU_GROUP_MEMORY_BUDGET env var) bounds the host RSS of
         grouping-state accumulation: past the budget, frequency deltas
         spill to disk as sorted runs and merge back streaming at finalize
-        (deequ_tpu/spill). None = unbounded, the historical behavior."""
+        (deequ_tpu/spill). None = unbounded, the historical behavior.
+
+        Resilience (streaming tables only; deequ_tpu/resilience):
+        ``checkpoint`` (a StreamCheckpointer or a directory path)
+        periodically persists the per-analyzer fold states so a killed run
+        resumes from its last checkpointed batch index with bit-identical
+        metrics; ``on_batch_error="skip"`` quarantines batches whose reads
+        keep failing past retries (indices reported on the context) instead
+        of failing the run; ``retry_policy`` overrides the batch-read
+        RetryPolicy (default: the table's, else the process default)."""
         if not analyzers:
             return AnalyzerContext.empty()
 
         analyzers = list(analyzers)
+
+        # an explicit retry policy must cover EVERY streaming path, not
+        # just the resilient branch: wrap the handle so the fused scan,
+        # grouping folds, and own-pass loops all read through it (the
+        # resilient loop's exhaustion handling recognizes the wrapper's
+        # RetryExhaustedException, so retries never multiply)
+        if retry_policy is not None and hasattr(data, "with_retry"):
+            data = data.with_retry(retry_policy)
 
         # (1) repository reuse (reference L116-134)
         results_loaded = AnalyzerContext.empty()
@@ -179,6 +233,33 @@ class AnalysisRunner:
             if isinstance(a, ScanShareableAnalyzer) and not _is_grouping_shared(a)
         ]
         own_pass = [a for a in passed if a not in grouping and a not in scanning]
+
+        # grouping analyzers share one frequency fold per distinct sorted
+        # grouping-column set — ONE partition rule for both the resilient
+        # branch below and step (5)
+        by_grouping: Dict[Tuple[str, ...], List[FrequencyBasedAnalyzer]] = {}
+        for analyzer in grouping:
+            key = tuple(sorted(analyzer.group_columns))
+            by_grouping.setdefault(key, []).append(analyzer)
+
+        # resilient streaming pass: checkpoint/resume and batch quarantine
+        # need per-batch fold state on the host, so ALL analyzers share one
+        # batch loop (fused per-batch scans for the scan-shareable set)
+        if getattr(data, "is_streaming", False) and (
+            checkpoint is not None or on_batch_error != "fail"
+        ):
+            resilient_ctx = AnalysisRunner._run_streaming_resilient(
+                data, scanning, own_pass, by_grouping,
+                aggregate_with, save_states_with,
+                group_memory_budget=group_memory_budget,
+                checkpoint=checkpoint, on_batch_error=on_batch_error,
+                retry_policy=retry_policy,
+            )
+            result = results_loaded + failure_ctx + resilient_ctx
+            _save_or_append_result(
+                metrics_repository, save_or_append_results_with_key, result
+            )
+            return result
 
         # (4) one fused scan for all shareable analyzers (reference L289-336)
         scan_ctx = AnalysisRunner._run_scanning_analyzers(
@@ -226,12 +307,9 @@ class AnalysisRunner:
                 )
 
         # (5) grouping analyzers share one frequency table per distinct
-        # sorted grouping-column set (reference L175-190)
+        # sorted grouping-column set (reference L175-190; partition built
+        # above, shared with the resilient branch)
         group_ctx = AnalyzerContext.empty()
-        by_grouping: Dict[Tuple[str, ...], List[FrequencyBasedAnalyzer]] = {}
-        for analyzer in grouping:
-            key = tuple(sorted(analyzer.group_columns))
-            by_grouping.setdefault(key, []).append(analyzer)
         for group_key, group_analyzers in by_grouping.items():
             group_ctx += AnalysisRunner._run_grouping_analyzers(
                 data, list(group_key), group_analyzers, aggregate_with,
@@ -243,18 +321,9 @@ class AnalysisRunner:
         )
 
         # (6) save to repository (reference L192-202)
-        if metrics_repository is not None and save_or_append_results_with_key is not None:
-            from deequ_tpu.repository import AnalysisResult
-
-            existing = metrics_repository.load_by_key(save_or_append_results_with_key)
-            combined = (
-                (existing.analyzer_context + result)
-                if existing is not None
-                else result
-            )
-            metrics_repository.save(
-                AnalysisResult(save_or_append_results_with_key, combined)
-            )
+        _save_or_append_result(
+            metrics_repository, save_or_append_results_with_key, result
+        )
 
         return result
 
@@ -465,7 +534,10 @@ class AnalysisRunner:
                     except Exception as e:  # noqa: BLE001
                         failed[a] = e
         except Exception as e:  # noqa: BLE001 — a source/read error fails
-            # every analyzer of the pass (the shared-scan failure rule)
+            # every analyzer of the pass (the shared-scan failure rule);
+            # release any spill stores so temp dirs don't outlive the run
+            for f in folders.values():
+                _release_spill(f)
             wrapped = wrap_if_necessary(e)
             return AnalyzerContext(
                 {a: a.to_failure_metric(wrapped) for a in analyzers}
@@ -477,10 +549,341 @@ class AnalysisRunner:
                 ctx.metric_map[a] = a.to_failure_metric(
                     wrap_if_necessary(failed[a])
                 )
+                # a failed fold's result() never runs: free its spill dir
+                _release_spill(folders[a])
             else:
                 ctx.metric_map[a] = a.calculate_metric(
                     folders[a].result(), aggregate_with, save_states_with
                 )
+        return ctx
+
+    @staticmethod
+    def _run_streaming_resilient(
+        data,
+        scanning: Sequence[ScanShareableAnalyzer],
+        own_pass: Sequence[Analyzer],
+        by_grouping: Dict[Tuple[str, ...], List],
+        aggregate_with=None,
+        save_states_with=None,
+        group_memory_budget=None,
+        checkpoint=None,
+        on_batch_error: str = "fail",
+        retry_policy=None,
+    ) -> AnalyzerContext:
+        """One resilient batch loop over the stream for EVERY analyzer
+        class (scan-shareable / own-pass / grouping), with host-resident
+        fold state so it can checkpoint and quarantine
+        (deequ_tpu/resilience):
+
+        - batch reads run through ``resilient_batches`` — transient errors
+          retry with backoff + reopen-at-batch; exhausted retries either
+          fail the pass (the shared-scan failure rule) or, with
+          ``on_batch_error="skip"``, quarantine the batch index (counted
+          on the context, reported on VerificationResult);
+        - scan-shareable analyzers still fuse into ONE device pass per
+          batch (`_dispatch_scanning_analyzers` on the in-memory batch) —
+          their states fold as host monoids, which is what makes them
+          checkpointable via states/serde;
+        - every ``checkpoint.every_batches`` folded batches the fold
+          stacks persist atomically+checksummed; on start, the newest
+          valid checkpoint with a matching run fingerprint restores the
+          stacks and the loop resumes at its batch index. The stacks ARE
+          the fold state, so resumed metrics are bit-identical to an
+          uninterrupted checkpointed run.
+
+        Trade-off vs the non-resilient paths: per-batch monoid folds
+        instead of the device-resident pipelined partials — checkpointable
+        state costs some scan-engine pipelining (measured by bench.py's
+        checkpoint-overhead probe)."""
+        from deequ_tpu.analyzers.base import StreamStateFolder
+        from deequ_tpu.ops.segment import group_counts_state
+        from deequ_tpu.resilience.checkpoint import (
+            StreamCheckpoint,
+            StreamCheckpointer,
+            run_fingerprint,
+        )
+        from deequ_tpu.resilience.retry import (
+            resilient_batches,
+            resolve_retry_policy,
+        )
+
+        if isinstance(checkpoint, str):
+            checkpoint = StreamCheckpointer(checkpoint)
+        policy = resolve_retry_policy(data, retry_policy)
+
+        # duplicate equal analyzers must fold ONCE (the repr-keyed folders
+        # collapse them; folding per list entry would double their counts)
+        scanning = list(dict.fromkeys(scanning))
+        own_pass = list(dict.fromkeys(own_pass))
+        by_grouping = {
+            g: list(dict.fromkeys(group_analyzers))
+            for g, group_analyzers in by_grouping.items()
+        }
+        per_analyzer = scanning + own_pass
+
+        # group memory budget: quarantine-only runs spill frequency folds
+        # to disk exactly like the non-resilient paths; a checkpointed run
+        # cannot (mid-store spill state is not serializable), which must
+        # be LOUD, not a silent OOM cliff
+        from deequ_tpu.spill import resolve_group_budget
+
+        budget = resolve_group_budget(data, group_memory_budget)
+        if budget is not None and checkpoint is not None:
+            import warnings
+
+            warnings.warn(
+                "group_memory_budget is ignored for checkpointed streaming "
+                "runs: spilled frequency state cannot be checkpointed; "
+                "frequency folds stay in host RAM",
+                stacklevel=2,
+            )
+            budget = None
+        spill_stores: List = []
+
+        def make_folder(spill_columns=None) -> StreamStateFolder:
+            if budget is not None and spill_columns is not None:
+                from deequ_tpu.spill import SpillingFrequencyStore
+
+                store = SpillingFrequencyStore(tuple(spill_columns), budget)
+                spill_stores.append(store)
+                return StreamStateFolder(
+                    spill_store=store, assume_canonical=True
+                )
+            return StreamStateFolder()
+
+        keys = {a: f"analyzer::{a!r}" for a in per_analyzer}
+        group_keys = {g: "group::" + ",".join(g) for g in by_grouping}
+        folders: Dict[str, StreamStateFolder] = {}
+        for a in scanning:
+            folders[keys[a]] = make_folder()
+        for a in own_pass:
+            folders[keys[a]] = make_folder(
+                # Histogram-style frequency states spill under the budget;
+                # their states are np.unique-label-sorted (canonical)
+                tuple(a.group_columns)
+                if isinstance(a, FrequencyBasedAnalyzer)
+                else None
+            )
+        for g in by_grouping:
+            folders[group_keys[g]] = make_folder(g)
+
+        # column pruning: union of every fold's needs (None = full width)
+        columns: Optional[set] = set()
+        for a in per_analyzer:
+            cols = a._stream_columns()
+            if cols is None:
+                columns = None
+                break
+            columns.update(cols)
+        if columns is not None:
+            for g in by_grouping:
+                columns.update(g)
+
+        # fingerprint: fold keys + batch geometry + whatever identity the
+        # source exposes (file paths, metadata row count) — a checkpoint
+        # from a run over different data must not resume this one
+        batch_rows = getattr(data, "preferred_batch_rows", None)
+        src = getattr(data, "source", None)
+        # wrappers (RetryingBatchSource, fault/test doubles) follow the
+        # ``.inner`` convention — walk the chain so the underlying file
+        # identity isn't hidden by a retry layer
+        src_id = None
+        probe, depth = src, 0
+        while probe is not None and src_id is None and depth < 8:
+            src_id = getattr(probe, "paths", None) or getattr(probe, "path", None)
+            probe = getattr(probe, "inner", None)
+            depth += 1
+        try:
+            known_rows = src.num_rows if src is not None else None
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            known_rows = None
+        fingerprint = run_fingerprint(
+            sorted(folders), (batch_rows, src_id, known_rows)
+        )
+
+        # exact batch count, when knowable: lets the iterator tell an
+        # unreadable batch from a failing END-OF-STREAM probe. Gated to
+        # row-sliced sources — variable-geometry readers (parquet row
+        # groups) can yield MORE batches than ceil(rows/batch_rows), and
+        # an over-tight bound would silently truncate on a late error
+        from deequ_tpu.data.source import TableBatchSource
+
+        innermost, depth = src, 0
+        while hasattr(innermost, "inner") and depth < 8:
+            innermost = innermost.inner
+            depth += 1
+        max_batches = None
+        if (
+            isinstance(innermost, TableBatchSource)
+            and known_rows is not None
+            and batch_rows
+        ):
+            max_batches = max(
+                (known_rows + batch_rows - 1) // batch_rows, 1
+            )
+
+        start = 0
+        skipped: List[int] = []
+        failed: Dict[Analyzer, Metric] = {}
+        failed_groups: Dict[Tuple[str, ...], Exception] = {}
+        if checkpoint is not None:
+            recovered = checkpoint.load_latest(fingerprint)
+            if recovered is not None:
+                start = recovered.batch_index
+                skipped = list(recovered.skipped)
+                for key, stack in recovered.stacks.items():
+                    if key in folders:
+                        folders[key]._stack = list(stack)
+                # failures are STICKY across resume: reviving an analyzer
+                # that dropped out before the checkpoint would report a
+                # success metric computed over a gap of batches
+                key_to_analyzer = {k: a for a, k in keys.items()}
+                key_to_group = {k: g for g, k in group_keys.items()}
+                for key, msg in recovered.failed.items():
+                    exc = MetricCalculationRuntimeException(
+                        f"{msg} (failed before the checkpoint at batch "
+                        f"{recovered.batch_index}; kept failed on resume)"
+                    )
+                    if key in key_to_analyzer:
+                        a = key_to_analyzer[key]
+                        failed[a] = a.to_failure_metric(exc)
+                    elif key in key_to_group:
+                        failed_groups[key_to_group[key]] = exc
+        read_cols = sorted(columns) if columns is not None else None
+
+        def fold_batch(batch) -> None:
+            alive_scan = [a for a in scanning if a not in failed]
+            if alive_scan:
+                # ops rebuild per batch by design: scan_op(batch) may bake
+                # batch-local state (string dictionaries); the expensive
+                # part — the traced device program — is reused across
+                # batches via each op's analyzer cache_key (scan_engine)
+                sctx, scannable, plan, results = (
+                    AnalysisRunner._dispatch_scanning_analyzers(
+                        batch, alive_scan
+                    )
+                )
+                failed.update(sctx.metric_map)
+                if results is not None:
+                    for a, (exec_idx, extract) in zip(scannable, plan):
+                        try:
+                            r = results[exec_idx]
+                            if extract is not None:
+                                r = extract(r)
+                            folders[keys[a]].add(a.state_from_scan_result(r))
+                        except Exception as e:  # noqa: BLE001
+                            failed[a] = a.to_failure_metric(
+                                wrap_if_necessary(e)
+                            )
+            for a in own_pass:
+                if a in failed:
+                    continue
+                try:
+                    folders[keys[a]].add(a.compute_state_from(batch))
+                except Exception as e:  # noqa: BLE001
+                    failed[a] = a.to_failure_metric(wrap_if_necessary(e))
+            for g in by_grouping:
+                if g in failed_groups:
+                    continue
+                try:
+                    folders[group_keys[g]].add(
+                        group_counts_state(
+                            batch, list(g),
+                            canonicalize=folders[group_keys[g]]._spill_store
+                            is not None,
+                        )
+                    )
+                except Exception as e:  # noqa: BLE001
+                    failed_groups[g] = wrap_if_necessary(e)
+
+        got_any = start > 0
+        try:
+            for idx, batch in resilient_batches(
+                lambda i: data.batches_from(i, columns=read_cols),
+                policy,
+                on_batch_error=on_batch_error,
+                quarantined=skipped,
+                start=start,
+                max_batches=max_batches,
+            ):
+                got_any = True
+                fold_batch(batch)
+                n_done = idx + 1
+                if (
+                    checkpoint is not None
+                    and n_done % checkpoint.every_batches == 0
+                ):
+                    failed_msgs = {
+                        keys[a]: str(getattr(m.value, "exception", m.value))
+                        for a, m in failed.items()
+                    }
+                    failed_msgs.update(
+                        {group_keys[g]: str(e) for g, e in failed_groups.items()}
+                    )
+                    checkpoint.save(
+                        fingerprint,
+                        StreamCheckpoint(
+                            n_done,
+                            list(skipped),
+                            {k: list(f._stack) for k, f in folders.items()},
+                            failed_msgs,
+                        ),
+                    )
+            if not got_any and not skipped:
+                # empty stream: fold one empty batch so counting analyzers
+                # emit identity metrics (Size=0), matching the fused
+                # streaming engine's all-padding chunk
+                from deequ_tpu.data.streaming import _empty_table
+
+                schema = (
+                    data.schema
+                    if read_cols is None
+                    else Schema([data.schema[c] for c in read_cols])
+                )
+                fold_batch(_empty_table(schema))
+        except Exception as e:  # noqa: BLE001 — a read failure past
+            # retries fails every analyzer of the pass (shared-scan rule);
+            # checkpoints written so far remain for the resume, but temp
+            # spill directories must not outlive the failed run
+            for store in spill_stores:
+                store.release()
+            wrapped = wrap_if_necessary(e)
+            ctx = AnalyzerContext(
+                {a: a.to_failure_metric(wrapped) for a in per_analyzer}
+            )
+            for g, group_analyzers in by_grouping.items():
+                for a in group_analyzers:
+                    ctx.metric_map[a] = a.to_failure_metric(wrapped)
+            ctx.skipped_batches = list(skipped)
+            return ctx
+
+        ctx = AnalyzerContext.empty()
+        for a in per_analyzer:
+            if a in failed:
+                ctx.metric_map[a] = failed[a]
+                # a failed fold's result() never runs: free its spill
+                # directory now instead of waiting on GC finalizers
+                _release_spill(folders[keys[a]])
+            else:
+                ctx.metric_map[a] = a.calculate_metric(
+                    folders[keys[a]].result(), aggregate_with, save_states_with
+                )
+        for g, group_analyzers in by_grouping.items():
+            if g in failed_groups:
+                for a in group_analyzers:
+                    ctx.metric_map[a] = a.to_failure_metric(failed_groups[g])
+                _release_spill(folders[group_keys[g]])
+            else:
+                merged = folders[group_keys[g]].result()
+                for a in group_analyzers:
+                    ctx.metric_map[a] = a.calculate_metric(
+                        merged, aggregate_with, save_states_with
+                    )
+        ctx.skipped_batches = list(skipped)
+        if checkpoint is not None:
+            # the run completed: a later run of this directory must start
+            # fresh, not resume past its own data
+            checkpoint.clear()
         return ctx
 
     @staticmethod
@@ -510,8 +913,8 @@ class AnalysisRunner:
             from deequ_tpu.analyzers.base import StreamStateFolder
 
             merged: Optional[State] = None
+            store = None
             try:
-                store = None
                 if budget is not None:
                     from deequ_tpu.spill import SpillingFrequencyStore
 
@@ -530,6 +933,10 @@ class AnalysisRunner:
                     )
                 merged = folder.result()
             except Exception as e:  # noqa: BLE001
+                # a failed fold must not leak its temp spill directory
+                # (the context-manager contract, spill/store.py)
+                if store is not None:
+                    store.release()
                 wrapped = wrap_if_necessary(e)
                 return AnalyzerContext(
                     {a: a.to_failure_metric(wrapped) for a in analyzers}
@@ -644,14 +1051,7 @@ class AnalysisRunner:
                     wrap_if_necessary(e)
                 )
 
-        if metrics_repository is not None and save_or_append_results_with_key is not None:
-            from deequ_tpu.repository import AnalysisResult
-
-            existing = metrics_repository.load_by_key(save_or_append_results_with_key)
-            combined = (
-                (existing.analyzer_context + ctx) if existing is not None else ctx
-            )
-            metrics_repository.save(
-                AnalysisResult(save_or_append_results_with_key, combined)
-            )
+        _save_or_append_result(
+            metrics_repository, save_or_append_results_with_key, ctx
+        )
         return ctx

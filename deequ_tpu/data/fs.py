@@ -37,6 +37,20 @@ class FileSystem:
     def delete(self, path: str) -> None:
         raise NotImplementedError
 
+    def rename(self, src: str, dst: str) -> None:
+        """Replace ``dst`` with ``src`` (the commit step of the crash-safe
+        write-temp-fsync-rename protocol, resilience/atomic.py).
+
+        Default: copy + delete, so FileSystem subclasses written against
+        the pre-resilience 6-method interface keep working. Override with
+        the store's native atomic rename where one exists — the fallback
+        is all-or-nothing only if the store's writes are."""
+        with self.open(src, "rb") as f:
+            data = f.read()
+        with self.open(dst, "wb") as f:
+            f.write(data)
+        self.delete(src)
+
     def join(self, *parts: str) -> str:
         return "/".join(p.rstrip("/") for p in parts[:-1]) + "/" + parts[-1]
 
@@ -57,6 +71,9 @@ class LocalFileSystem(FileSystem):
     def delete(self, path: str) -> None:
         if os.path.exists(path):
             os.remove(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)  # POSIX atomic replace
 
     def join(self, *parts: str) -> str:
         return os.path.join(*parts)
@@ -93,6 +110,11 @@ class FsspecFileSystem(FileSystem):
     def delete(self, path: str) -> None:  # pragma: no cover
         if self._fs.exists(path):
             self._fs.rm(path)
+
+    def rename(self, src: str, dst: str) -> None:  # pragma: no cover
+        # object stores rename by copy+delete; their single-object puts
+        # are already all-or-nothing, so this is still crash-safe
+        self._fs.mv(src, dst)
 
 
 class InMemoryFileSystem(FileSystem):
@@ -135,6 +157,11 @@ class InMemoryFileSystem(FileSystem):
 
     def delete(self, path: str) -> None:
         self.files.pop(path, None)
+
+    def rename(self, src: str, dst: str) -> None:
+        if src not in self.files:
+            raise FileNotFoundError(src)
+        self.files[dst] = self.files.pop(src)
 
 
 _REGISTRY: Dict[str, Callable[[str], FileSystem]] = {}

@@ -54,6 +54,34 @@ class BatchSource:
     ) -> Iterator[ColumnarTable]:
         raise NotImplementedError
 
+    def batches_from(
+        self,
+        start: int = 0,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        """Batches from batch index ``start`` — the seek primitive the
+        resilience layer's retry-reopen and checkpoint-resume paths use
+        (deequ_tpu/resilience). Batch boundaries are deterministic for a
+        fixed ``batch_rows``, so index k names the same rows every call.
+
+        Default: re-iterate and drop the first ``start`` batches (skipped
+        batches are re-decoded but not processed). Sources with native
+        seeks override this."""
+        import itertools
+
+        return itertools.islice(
+            self.batches(columns=columns, batch_rows=batch_rows), start, None
+        )
+
+    def with_retry(self, policy=None):
+        """This source wrapped so every batch read runs under a
+        RetryPolicy (resilience/retry.py: reopen-and-fast-forward on
+        transient errors)."""
+        from deequ_tpu.resilience.retry import RetryingBatchSource
+
+        return RetryingBatchSource(self, policy)
+
 
 def _restrict_arrow_schema(arrow_schema, names, what: str):
     """Map requested column names onto an arrow schema -> engine Fields."""
@@ -385,6 +413,15 @@ class TableBatchSource(BatchSource):
         columns: Optional[Sequence[str]] = None,
         batch_rows: Optional[int] = None,
     ) -> Iterator[ColumnarTable]:
+        yield from self.batches_from(0, columns=columns, batch_rows=batch_rows)
+
+    def batches_from(
+        self,
+        start: int = 0,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        # native seek: the table is resident, so start is row arithmetic
         rows = batch_rows or self._batch_rows or batch_rows_for_schema(self.schema)
         names = (
             [n for n in self.table.column_names if n in set(columns)]
@@ -393,10 +430,10 @@ class TableBatchSource(BatchSource):
         )
         n = self.table.num_rows
         view = self.table.select(names)
-        for start in range(0, max(n, 1), rows):
-            idx = np.arange(start, min(start + rows, n))
+        for row0 in range(start * rows, max(n, 1) if start == 0 else n, rows):
+            idx = np.arange(row0, min(row0 + rows, n))
             yield ColumnarTable([view[c].take(idx) for c in names])
-            if start + rows >= n:
+            if row0 + rows >= n:
                 break
 
 

@@ -56,6 +56,7 @@ class StreamingTable:
         ] = None,
         schema_override: Optional[Schema] = None,
         group_memory_budget: Optional[int] = None,
+        retry_policy=None,
     ):
         # each transform is (fn, input_columns): the inputs are added to
         # column-pruned reads so transforms keep working without forcing a
@@ -67,6 +68,9 @@ class StreamingTable:
         # analyzers read it via spill.resolve_group_budget): frequency
         # tables spill to sorted disk runs past this many bytes
         self.group_memory_budget = group_memory_budget
+        # batch-read retry policy carried by the data handle (runners read
+        # it via resilience.retry.resolve_retry_policy)
+        self.retry_policy = retry_policy
 
     # -- schema surface (everything the planner touches) --------------------
 
@@ -115,6 +119,28 @@ class StreamingTable:
         batch_rows: Optional[int] = None,
     ) -> Iterator[ColumnarTable]:
         """Yield ColumnarTable batches (optionally column-pruned)."""
+        yield from self.batches_from(0, columns=columns, batch_rows=batch_rows)
+
+    def batches_from(
+        self,
+        start: int = 0,
+        columns: Optional[Sequence[str]] = None,
+        batch_rows: Optional[int] = None,
+    ) -> Iterator[ColumnarTable]:
+        """Batches from batch index ``start`` — the seek primitive the
+        resilience layer's retry/checkpoint-resume paths use; transforms
+        apply per batch exactly as in ``batches``."""
+        def src_from(start_idx, read_cols, rows):
+            if hasattr(self.source, "batches_from"):
+                return self.source.batches_from(
+                    start_idx, columns=read_cols, batch_rows=rows
+                )
+            # duck-typed sources that only implement batches(): the base
+            # protocol's islice fallback works unbound on any of them
+            return BatchSource.batches_from(
+                self.source, start_idx, columns=read_cols, batch_rows=rows
+            )
+
         if self._transforms:
             # read the requested columns plus every transform input, apply
             # transforms per batch, then prune to the request
@@ -124,7 +150,7 @@ class StreamingTable:
                 for _, inputs in self._transforms:
                     want |= inputs
                 read = [n for n in self.source.schema.column_names if n in want]
-            for raw in self.source.batches(columns=read, batch_rows=batch_rows):
+            for raw in src_from(start, read, batch_rows):
                 batch = raw
                 for fn, _ in self._transforms:
                     batch = fn(batch)
@@ -135,7 +161,7 @@ class StreamingTable:
                     )
                 yield batch
         else:
-            yield from self.source.batches(columns=columns, batch_rows=batch_rows)
+            yield from src_from(start, columns, batch_rows)
 
     # -- lazy per-batch column casts (profiler pass-2 support) ---------------
 
@@ -163,6 +189,7 @@ class StreamingTable:
             self._transforms + [(transform, frozenset(casts))],
             Schema(fields),
             group_memory_budget=self.group_memory_budget,
+            retry_policy=self.retry_policy,
         )
 
     def with_group_memory_budget(self, budget_bytes: int) -> "StreamingTable":
@@ -175,6 +202,24 @@ class StreamingTable:
             self._transforms,
             self._schema,
             group_memory_budget=int(budget_bytes),
+            retry_policy=self.retry_policy,
+        )
+
+    def with_retry(self, policy=None) -> "StreamingTable":
+        """A new handle whose batch reads run under ``policy`` (a
+        resilience.RetryPolicy; None = the default I/O policy): transient
+        source errors cost a backoff + reopen-at-batch instead of the run.
+        The policy rides on the handle, so every consumer — the fused
+        streaming scan, grouping folds, the profiler — reads through it."""
+        from deequ_tpu.resilience.retry import DEFAULT_IO_RETRY, RetryingBatchSource
+
+        policy = policy if policy is not None else DEFAULT_IO_RETRY
+        return StreamingTable(
+            RetryingBatchSource(self.source, policy),
+            self._transforms,
+            self._schema,
+            group_memory_budget=self.group_memory_budget,
+            retry_policy=policy,
         )
 
     # -- materialization guards ----------------------------------------------

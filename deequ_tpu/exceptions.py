@@ -47,6 +47,33 @@ class EmptyStateException(MetricCalculationRuntimeException):
     pass
 
 
+class CorruptStateException(MetricCalculationRuntimeException):
+    """Persisted bytes failed integrity validation (checksum mismatch,
+    torn write, undecodable payload). Raised instead of the raw
+    JSON/struct error so callers can distinguish 'the file is damaged'
+    from 'the code is wrong' — damaged state is recoverable by
+    recomputing; a struct error is a bug."""
+
+    def __init__(self, what: str, detail: str = ""):
+        msg = f"corrupt persisted state: {what}"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.what = what
+
+
+class RetryExhaustedException(MetricCalculationRuntimeException):
+    """A retried I/O operation kept failing past the RetryPolicy's attempt
+    budget or deadline. ``__cause__`` carries the last underlying error."""
+
+    def __init__(self, what: str, attempts: int, cause: BaseException):
+        super().__init__(
+            f"{what} still failing after {attempts} attempts: {cause}"
+        )
+        self.attempts = attempts
+        self.__cause__ = cause
+
+
 def wrap_if_necessary(exception: BaseException) -> MetricCalculationException:
     """Ensure an arbitrary error is a MetricCalculationException (reference L69)."""
     if isinstance(exception, MetricCalculationException):

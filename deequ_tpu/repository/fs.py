@@ -1,6 +1,16 @@
 """Filesystem metrics repository — one JSON file of all results, read-modify-
 write (reference repository/fs/FileSystemMetricsRepository.scala:32-226).
-Local paths play the role of HDFS/S3."""
+Local paths play the role of HDFS/S3.
+
+Crash safety (resilience layer): ``_write_all`` commits via
+write-temp-fsync-rename, so a crash mid-write leaves the previous complete
+history, never a torn one; the file is wrapped in the shared checksum
+envelope (resilience/atomic.py), so corruption that does reach disk (torn
+writes on non-atomic stores, bit rot) is detected on read and surfaced as
+a typed ``CorruptStateException`` instead of a raw ``JSONDecodeError``.
+Storage calls run under the process retry policy (transient IOErrors are
+retried with backoff). Legacy plain-JSON files keep loading.
+"""
 
 from __future__ import annotations
 
@@ -8,6 +18,7 @@ import os
 import threading
 from typing import List, Optional
 
+from deequ_tpu.exceptions import CorruptStateException
 from deequ_tpu.repository import serde
 from deequ_tpu.repository.base import (
     AnalysisResult,
@@ -21,26 +32,46 @@ from deequ_tpu.analyzers.runner import AnalyzerContext
 class FileSystemMetricsRepository(MetricsRepository):
     def __init__(self, path: str):
         from deequ_tpu.data.fs import filesystem_for, strip_scheme
+        from deequ_tpu.resilience.retry import RetryingFileSystem
 
         self.path = strip_scheme(path)
-        self._fs = filesystem_for(path)
+        self._fs = RetryingFileSystem(filesystem_for(path))
         self._lock = threading.Lock()
 
     def _read_all(self) -> List[AnalysisResult]:
         if not self._fs.exists(self.path):
             return []
-        with self._fs.open(self.path, "r") as f:
-            text = f.read()
+        from deequ_tpu.resilience.atomic import read_checksummed
+
+        # enveloped files validate + strip; legacy plain-JSON files (no
+        # envelope magic) pass through as raw bytes
+        data = read_checksummed(
+            self._fs, self.path, f"metrics repository {self.path}"
+        )
+        try:
+            text = data.decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise CorruptStateException(
+                f"metrics repository {self.path}", f"undecodable bytes: {e}"
+            ) from e
         if not text.strip():
             return []
-        return serde.deserialize(text)
+        try:
+            return serde.deserialize(text)
+        except (ValueError, KeyError, TypeError) as e:
+            raise CorruptStateException(
+                f"metrics repository {self.path}",
+                f"undecodable results payload: {e}",
+            ) from e
 
     def _write_all(self, results: List[AnalysisResult]) -> None:
         parent = os.path.dirname(self.path)
         if parent:
             self._fs.makedirs(parent)
-        with self._fs.open(self.path, "w") as f:
-            f.write(serde.serialize(results))
+        from deequ_tpu.resilience.atomic import atomic_write_bytes, wrap_checksum
+
+        payload = serde.serialize(results).encode("utf-8")
+        atomic_write_bytes(self._fs, self.path, wrap_checksum(payload))
 
     def save(self, result: AnalysisResult) -> None:
         successful = AnalyzerContext(

@@ -1,0 +1,82 @@
+"""Resilience layer — retry/backoff, crash-safe persistence, fault
+injection, and checkpoint/resume for streaming verification.
+
+deequ's founding philosophy treats metric failure as data
+(``tryresult.py``, ``exceptions.py``); this package extends the same
+philosophy down to the I/O and streaming layers, where TB-scale runs meet
+transient reads, torn writes, and mid-stream crashes:
+
+- :mod:`deequ_tpu.resilience.retry` — ``RetryPolicy`` (exponential
+  backoff + jitter + deadline), filesystem/batch-source retry wrappers,
+  and the quarantine-aware ``resilient_batches`` iterator;
+- :mod:`deequ_tpu.resilience.atomic` — write-temp-fsync-rename plus
+  checksum envelopes; corruption surfaces as ``CorruptStateException``;
+- :mod:`deequ_tpu.resilience.checkpoint` — periodic persistence of the
+  streaming runner's fold stacks so a killed run resumes bit-identically
+  from its last checkpoint;
+- :mod:`deequ_tpu.resilience.faults` — the deterministic seeded
+  fault-injection harness (``FaultInjectingFileSystem``,
+  ``FlakyBatchSource``) the resilience test suite drives.
+"""
+
+from deequ_tpu.exceptions import (  # noqa: F401 — canonical home is exceptions
+    CorruptStateException,
+    RetryExhaustedException,
+)
+from deequ_tpu.resilience.atomic import (
+    atomic_write_bytes,
+    atomic_write_text,
+    has_checksum,
+    read_checksummed,
+    unwrap_checksum,
+    wrap_checksum,
+)
+from deequ_tpu.resilience.checkpoint import (
+    StreamCheckpoint,
+    StreamCheckpointer,
+    run_fingerprint,
+)
+from deequ_tpu.resilience.faults import (
+    FaultInjectingFileSystem,
+    FaultSchedule,
+    FlakyBatchSource,
+    InjectedIOError,
+)
+from deequ_tpu.resilience.retry import (
+    DEFAULT_IO_RETRY,
+    RetryingBatchSource,
+    RetryingFileSystem,
+    RetryPolicy,
+    default_retry_policy,
+    resilient_batches,
+    resolve_retry_policy,
+    retry_call,
+    set_default_retry_policy,
+)
+
+__all__ = [
+    "CorruptStateException",
+    "RetryExhaustedException",
+    "RetryPolicy",
+    "DEFAULT_IO_RETRY",
+    "default_retry_policy",
+    "set_default_retry_policy",
+    "retry_call",
+    "resolve_retry_policy",
+    "resilient_batches",
+    "RetryingFileSystem",
+    "RetryingBatchSource",
+    "atomic_write_bytes",
+    "atomic_write_text",
+    "wrap_checksum",
+    "unwrap_checksum",
+    "has_checksum",
+    "read_checksummed",
+    "StreamCheckpoint",
+    "StreamCheckpointer",
+    "run_fingerprint",
+    "FaultSchedule",
+    "FaultInjectingFileSystem",
+    "FlakyBatchSource",
+    "InjectedIOError",
+]

@@ -1,0 +1,122 @@
+"""Crash-safe persistence primitives: write-temp-fsync-rename + checksums.
+
+A metrics repository or state file that tears mid-write must never be
+half-readable: readers either see the previous complete version (atomic
+rename) or detect damage loudly (checksum envelope -> typed
+CorruptStateException) instead of surfacing a raw JSON/struct error from
+arbitrary garbage. Native engines isolate storage faults the same way
+rather than failing the query (Flare, arXiv:1703.08219).
+
+The checksum envelope is ``DQX1 | crc32(u32) | length(i64) | payload``;
+``has_checksum`` distinguishes enveloped files from legacy raw payloads so
+pre-resilience files keep loading.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import struct
+import zlib
+from typing import Optional
+
+from deequ_tpu.exceptions import CorruptStateException
+
+CHECKSUM_MAGIC = b"DQX1"
+
+_u32 = struct.Struct("<I")
+_i64 = struct.Struct("<q")
+
+# process-unique temp suffixes: pid guards cross-process collisions, the
+# counter guards same-process concurrent writers on one path
+_tmp_counter = itertools.count()
+
+
+def wrap_checksum(payload: bytes) -> bytes:
+    """payload -> checksummed envelope bytes."""
+    return (
+        CHECKSUM_MAGIC
+        + _u32.pack(zlib.crc32(payload) & 0xFFFFFFFF)
+        + _i64.pack(len(payload))
+        + payload
+    )
+
+
+def has_checksum(data: bytes) -> bool:
+    return data[:4] == CHECKSUM_MAGIC
+
+
+def unwrap_checksum(data: bytes, what: str) -> bytes:
+    """Envelope bytes -> payload; CorruptStateException on any damage
+    (bad magic, truncation, crc mismatch)."""
+    if not has_checksum(data):
+        raise CorruptStateException(what, "missing checksum envelope")
+    if len(data) < 16:
+        raise CorruptStateException(what, "truncated envelope header")
+    (crc,) = _u32.unpack_from(data, 4)
+    (length,) = _i64.unpack_from(data, 8)
+    payload = data[16:]
+    if len(payload) != length:
+        raise CorruptStateException(
+            what, f"torn write: expected {length} payload bytes, "
+            f"found {len(payload)}"
+        )
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise CorruptStateException(what, "checksum mismatch")
+    return payload
+
+
+def _fsync_if_possible(handle) -> None:
+    try:
+        handle.flush()
+        os.fsync(handle.fileno())
+    except (AttributeError, OSError, ValueError):
+        pass  # in-memory / object-store handles have no fd; rename still
+        # gives all-or-nothing visibility there
+
+
+def atomic_write_bytes(
+    fs, path: str, data: bytes, retry=None, what: Optional[str] = None
+) -> None:
+    """Write ``data`` to ``path`` via temp-file + fsync + rename on the
+    given FileSystem: concurrent/crashed readers see either the old
+    complete file or the new complete file, never a prefix. Runs under
+    ``retry`` (a RetryPolicy, or the process default when None)."""
+    from deequ_tpu.resilience.retry import retry_call
+
+    what = what or f"write {path}"
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
+
+    def attempt() -> None:
+        with fs.open(tmp, "wb") as f:
+            f.write(data)
+            _fsync_if_possible(f)
+        fs.rename(tmp, path)
+
+    try:
+        retry_call(attempt, retry, what=what)
+    except BaseException:
+        try:
+            fs.delete(tmp)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+        raise
+
+
+def atomic_write_text(fs, path: str, text: str, retry=None) -> None:
+    atomic_write_bytes(fs, path, text.encode("utf-8"), retry=retry)
+
+
+def read_checksummed(fs, path: str, what: str, retry=None) -> bytes:
+    """Read + validate a checksummed file; legacy files (no envelope)
+    return their raw bytes unchanged."""
+    from deequ_tpu.resilience.retry import retry_call
+
+    def attempt() -> bytes:
+        with fs.open(path, "rb") as f:
+            return f.read()
+
+    data = retry_call(attempt, retry, what=f"read {path}")
+    if has_checksum(data):
+        return unwrap_checksum(data, what)
+    return data

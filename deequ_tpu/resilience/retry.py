@@ -1,0 +1,355 @@
+"""RetryPolicy — exponential backoff + jitter + deadline for transient I/O.
+
+Long-running stream pipelines treat failure as routine (TiLT,
+arXiv:2301.12030): a TB-scale verification run will see transient storage
+errors, and a single flaky read must cost one retry, not the whole run.
+The policy is a value object; the three application points are
+
+- ``retry_call`` — wrap any one-shot I/O callable (filesystem opens,
+  spill-run opens);
+- ``RetryingFileSystem`` — a FileSystem proxy whose every operation runs
+  under the policy (repository + state-provider storage);
+- ``resilient_batches`` / ``RetryingBatchSource`` — per-batch retry with
+  reopen-and-fast-forward over a ``BatchSource``, plus the quarantine
+  policy (``on_batch_error="skip"``) used by streaming verification runs.
+
+Determinism: jitter draws from a policy-owned ``random.Random(seed)``, so
+tests (and reproductions of production incidents) see identical sleep
+schedules for identical failure sequences.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from deequ_tpu.exceptions import RetryExhaustedException
+
+# errors worth retrying by default: the OS/network layer, not logic errors
+DEFAULT_RETRY_ON = (OSError, TimeoutError)
+
+
+def _quarantinable(exc: BaseException) -> bool:
+    """Errors that mean 'this batch is unreadable/undecodable' — eligible
+    for quarantine under on_batch_error='skip'. I/O errors, typed
+    corruption, and decoder-layer errors (pyarrow) qualify; an arbitrary
+    exception is treated as a bug and propagates."""
+    if isinstance(exc, DEFAULT_RETRY_ON):
+        return True
+    from deequ_tpu.exceptions import CorruptStateException
+
+    if isinstance(exc, CorruptStateException):
+        return True
+    try:  # decode errors from the arrow readers (torn/corrupt data pages)
+        import pyarrow as pa
+
+        return isinstance(exc, pa.lib.ArrowException)
+    except Exception:  # noqa: BLE001 — pyarrow absent: nothing to match
+        return False
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter and an optional wall deadline.
+
+    Delay for attempt k (0-based) is
+    ``min(base_delay * multiplier**k, max_delay)`` scaled by a jitter draw
+    in ``[1 - jitter, 1]``; the whole retried operation must finish within
+    ``deadline`` seconds of its first attempt (None = no deadline)."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    deadline: Optional[float] = None
+    retry_on: Tuple[type, ...] = DEFAULT_RETRY_ON
+    seed: int = 0
+    _rng: Random = field(init=False, repr=False, compare=False, hash=False)
+
+    def __post_init__(self):
+        object.__setattr__(self, "_rng", Random(self.seed))
+
+    def delay_for(self, attempt: int) -> float:
+        raw = min(
+            self.base_delay * (self.multiplier ** attempt), self.max_delay
+        )
+        if self.jitter:
+            raw *= 1.0 - self.jitter * self._rng.random()
+        return raw
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.retry_on)
+
+    def call(self, fn: Callable, *args, what: str = "operation", **kwargs):
+        """Run ``fn`` under the policy; raises RetryExhaustedException when
+        the attempt budget or deadline runs out."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — filtered below
+                if not self.is_retryable(e):
+                    raise
+                attempt += 1
+                out_of_time = (
+                    self.deadline is not None
+                    and time.monotonic() - start >= self.deadline
+                )
+                if attempt >= self.max_attempts or out_of_time:
+                    raise RetryExhaustedException(what, attempt, e) from e
+                time.sleep(self.delay_for(attempt - 1))
+
+
+# conservative default for storage-layer wrapping: quick, bounded, and a
+# no-op on healthy storage
+DEFAULT_IO_RETRY = RetryPolicy(max_attempts=3, base_delay=0.02, max_delay=0.5)
+
+# retrying is strictly additive behavior, but deployments may want it off
+# (e.g. under a fault-injection harness testing the UNretried paths)
+_default_policy: Optional[RetryPolicy] = DEFAULT_IO_RETRY
+
+
+def default_retry_policy() -> Optional[RetryPolicy]:
+    return _default_policy
+
+
+def set_default_retry_policy(policy: Optional[RetryPolicy]) -> None:
+    """Install the process-wide storage-retry policy (None disables)."""
+    global _default_policy
+    _default_policy = policy
+
+
+def retry_call(fn: Callable, policy: Optional[RetryPolicy] = None,
+               what: str = "operation"):
+    """``fn()`` under ``policy`` (or the process default; no policy = one
+    plain call)."""
+    policy = policy if policy is not None else _default_policy
+    if policy is None:
+        return fn()
+    return policy.call(fn, what=what)
+
+
+def resolve_retry_policy(data=None, explicit: Optional[RetryPolicy] = None):
+    """Policy resolution for batch reads: explicit argument > table
+    attribute (``StreamingTable.with_retry``) > process default."""
+    if explicit is not None:
+        return explicit
+    attr = getattr(data, "retry_policy", None)
+    if attr is not None:
+        return attr
+    return _default_policy
+
+
+class RetryingFileSystem:
+    """FileSystem proxy running every operation under a RetryPolicy.
+
+    ``open`` retries the open call itself; an error raised mid-read/write
+    from the returned handle propagates (the caller's unit of retry is the
+    whole read-or-write, e.g. ``atomic_write_bytes``)."""
+
+    def __init__(self, inner, policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.policy = policy
+
+    def _call(self, name: str, *args, **kwargs):
+        return retry_call(
+            lambda: getattr(self.inner, name)(*args, **kwargs),
+            self.policy,
+            what=f"filesystem {name}",
+        )
+
+    def open(self, path: str, mode: str = "rb"):
+        return self._call("open", path, mode)
+
+    def exists(self, path: str) -> bool:
+        return self._call("exists", path)
+
+    def makedirs(self, path: str) -> None:
+        self._call("makedirs", path)
+
+    def listdir(self, path: str) -> List[str]:
+        return self._call("listdir", path)
+
+    def delete(self, path: str) -> None:
+        self._call("delete", path)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._call("rename", src, dst)
+
+    def join(self, *parts: str) -> str:
+        return self.inner.join(*parts)
+
+
+def retrying_filesystem_for(path: str):
+    """``filesystem_for(path)`` wrapped in the process retry policy —
+    the storage resolution used by the persistence layers."""
+    from deequ_tpu.data.fs import filesystem_for
+
+    return RetryingFileSystem(filesystem_for(path), None)
+
+
+# -- resilient batch iteration ----------------------------------------------
+
+
+def resilient_batches(
+    make_iter: Callable[[int], Iterator],
+    policy: Optional[RetryPolicy],
+    on_batch_error: str = "fail",
+    quarantined: Optional[List[int]] = None,
+    start: int = 0,
+    max_consecutive_skips: int = 16,
+    max_batches: Optional[int] = None,
+):
+    """Iterate batches with per-batch retry and optional quarantine.
+
+    ``make_iter(i)`` must return a fresh iterator positioned at batch
+    index ``i`` (``BatchSource.batches_from``) — deterministic batch
+    boundaries are the caller's contract, which every built-in source
+    satisfies for a fixed ``batch_rows``. Yields ``(index, batch)``.
+
+    On a retryable error the iterator is reopened at the failing index
+    after backoff (fast-forward is the source's job; the default
+    ``batches_from`` islice implementation re-decodes skipped batches,
+    sources with native seeks override it). When retries exhaust:
+    ``on_batch_error="fail"`` re-raises (RetryExhaustedException),
+    ``"skip"`` records the index in ``quarantined`` and resumes at the
+    next batch — a poisoned batch costs its rows, not the run.
+
+    ``max_consecutive_skips`` bounds quarantine's optimism: storage that
+    is PERMANENTLY dead fails every index, and skipping forever would
+    never reach end-of-stream — past this many back-to-back quarantines
+    with no successful read between them, the pass fails instead.
+
+    ``max_batches`` (when the caller knows the batch count from source
+    metadata) distinguishes 'batch cur is unreadable' from 'the END-OF-
+    STREAM probe errored': an error at an index past the last real batch
+    ends the iteration cleanly instead of quarantining phantom indices
+    or failing a run whose data was fully read.
+    """
+    if on_batch_error not in ("fail", "skip"):
+        raise ValueError(
+            f"on_batch_error must be 'fail' or 'skip', got {on_batch_error!r}"
+        )
+    cur = start
+    attempts = 0
+    consecutive_skips = 0
+    t0 = time.monotonic()
+    while True:
+        it = make_iter(cur)
+        try:
+            while True:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                yield cur, batch
+                cur += 1
+                attempts = 0
+                consecutive_skips = 0
+                t0 = time.monotonic()
+        except BaseException as e:  # noqa: BLE001 — filtered below
+            if max_batches is not None and cur >= max_batches:
+                # every real batch was read; this error came from the
+                # end-of-stream probe, not from data
+                return
+            # a RetryExhaustedException means an inner retry layer (e.g. a
+            # source wrapped by with_retry -> RetryingBatchSource) already
+            # spent its attempt budget on this batch: don't multiply
+            # retries — treat the batch as exhausted here and now
+            already_exhausted = isinstance(e, RetryExhaustedException)
+            retryable = (
+                not already_exhausted
+                and policy is not None
+                and policy.is_retryable(e)
+            )
+            skippable = on_batch_error == "skip" and (
+                already_exhausted or _quarantinable(e)
+            )
+            if not retryable and not skippable:
+                raise
+            attempts += 1
+            # non-retryable-but-skippable errors quarantine IMMEDIATELY:
+            # the policy's retry_on filter said backoff cannot help here
+            out_of_budget = (
+                already_exhausted
+                or not retryable
+                or policy is None
+                or attempts >= policy.max_attempts
+            )
+            if policy is not None and policy.deadline is not None:
+                out_of_budget = out_of_budget or (
+                    time.monotonic() - t0 >= policy.deadline
+                )
+            if out_of_budget:
+                if on_batch_error == "skip":
+                    consecutive_skips += 1
+                    if consecutive_skips > max_consecutive_skips:
+                        raise RetryExhaustedException(
+                            f"{consecutive_skips} consecutive batches "
+                            f"unreadable (through batch {cur}) — the source "
+                            f"looks permanently dead, not patchily flaky",
+                            attempts,
+                            e,
+                        ) from e
+                    if quarantined is not None:
+                        quarantined.append(cur)
+                    cur += 1
+                    attempts = 0
+                    t0 = time.monotonic()
+                    continue
+                raise RetryExhaustedException(
+                    f"batch {cur} read", attempts, e
+                ) from e
+            time.sleep(policy.delay_for(attempts - 1))
+
+
+class RetryingBatchSource:
+    """BatchSource wrapper: every batch read runs under a RetryPolicy
+    (reopen-and-fast-forward on transient errors). Plugs in anywhere a
+    source does — the fused streaming scan, grouping folds, the profiler —
+    because the retrying happens inside ``batches``/``batches_from``."""
+
+    def __init__(self, inner, policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.policy = policy if policy is not None else DEFAULT_IO_RETRY
+
+    @property
+    def schema(self):
+        return self.inner.schema
+
+    @property
+    def num_rows(self):
+        return self.inner.num_rows
+
+    @property
+    def _batch_rows(self):
+        return getattr(self.inner, "_batch_rows", None)
+
+    def batches(self, columns=None, batch_rows=None):
+        yield from self.batches_from(0, columns=columns, batch_rows=batch_rows)
+
+    def _inner_from(self, start, columns, batch_rows):
+        if hasattr(self.inner, "batches_from"):
+            return self.inner.batches_from(
+                start, columns=columns, batch_rows=batch_rows
+            )
+        # duck-typed sources that only implement batches(): the base
+        # protocol's islice fallback works unbound on any of them
+        from deequ_tpu.data.source import BatchSource
+
+        return BatchSource.batches_from(
+            self.inner, start, columns=columns, batch_rows=batch_rows
+        )
+
+    def batches_from(self, start: int = 0, columns=None, batch_rows=None):
+        for _idx, batch in resilient_batches(
+            lambda i: self._inner_from(i, columns, batch_rows),
+            self.policy,
+            on_batch_error="fail",
+            start=start,
+        ):
+            yield batch

@@ -15,24 +15,32 @@ key that sorts last — exactly the order ``np.unique(equal_nan=True)``
 codes induce, i.e. the order ``FrequenciesAndNumRows.sum`` already emits.
 
 Layout: ``MAGIC(4) | VERSION(u16) | n_cols(u16)`` then repeated blocks of
-``block_nbytes(i64) | G(i64) | counts(<i8 * G) | key column blocks``; all
-integers little-endian, EOF terminates.
+``block_nbytes(i64) | crc32(u32) | G(i64) | counts(<i8 * G) | key column
+blocks``; all integers little-endian, EOF terminates. The per-block crc32
+is new in v2 (torn/corrupted blocks surface as a typed
+CorruptStateException instead of a struct error); v1 files — no crc —
+still read. File opens run under the process retry policy
+(resilience/retry.py), so a transient IOError costs a backoff, not the
+whole spilled grouping.
 """
 
 from __future__ import annotations
 
 import os
 import struct
+import zlib
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from deequ_tpu.exceptions import CorruptStateException
 from deequ_tpu.states.serde import decode_key_column, encode_key_column
 
 MAGIC = b"DQRN"
-VERSION = 1
+VERSION = 2
 
 _u16 = struct.Struct("<H")
+_u32 = struct.Struct("<I")
 _i64 = struct.Struct("<q")
 
 # A frequency block: (key_values per column, key_nulls per column, counts).
@@ -71,11 +79,15 @@ class RunWriter:
     (the store sorts + dedups before flushing)."""
 
     def __init__(self, path: str, n_cols: int):
+        from deequ_tpu.resilience.retry import retry_call
+
         self.path = path
         self.n_cols = n_cols
         self.groups_written = 0
         self.bytes_written = 0
-        self._f = open(path, "wb")
+        self._f = retry_call(
+            lambda: open(path, "wb"), what=f"open spill run {path}"
+        )
         header = MAGIC + _u16.pack(VERSION) + _u16.pack(n_cols)
         self._f.write(header)
         self.bytes_written += len(header)
@@ -90,9 +102,10 @@ class RunWriter:
             return
         payload = encode_block(key_values, key_nulls, counts)
         self._f.write(_i64.pack(len(payload)))
+        self._f.write(_u32.pack(zlib.crc32(payload) & 0xFFFFFFFF))
         self._f.write(payload)
         self.groups_written += len(counts)
-        self.bytes_written += 8 + len(payload)
+        self.bytes_written += 12 + len(payload)
 
     def close(self) -> None:
         if self._f is not None:
@@ -118,32 +131,56 @@ class RunReader:
     """Streams one run's blocks back; holds ONE block in memory."""
 
     def __init__(self, path: str):
+        from deequ_tpu.resilience.retry import retry_call
+
         self.path = path
         self.bytes_read = 0
-        with open(path, "rb") as f:
+        with retry_call(
+            lambda: open(path, "rb"), what=f"open spill run {path}"
+        ) as f:
             header = f.read(8)
         if header[:4] != MAGIC:
             raise ValueError(f"{path} is not a spill run file (bad magic)")
-        (version,) = _u16.unpack_from(header, 4)
-        if version > VERSION:
+        (self.version,) = _u16.unpack_from(header, 4)
+        if self.version > VERSION:
             raise ValueError(
-                f"spill run version {version} is newer than supported "
+                f"spill run version {self.version} is newer than supported "
                 f"{VERSION}"
             )
         (self.n_cols,) = _u16.unpack_from(header, 6)
 
     def blocks(self) -> Iterator[Block]:
-        with open(self.path, "rb") as f:
+        from deequ_tpu.resilience.retry import retry_call
+
+        with retry_call(
+            lambda: open(self.path, "rb"), what=f"open spill run {self.path}"
+        ) as f:
             f.seek(8)
             while True:
                 size_raw = f.read(8)
                 if len(size_raw) < 8:
                     return
                 (nbytes,) = _i64.unpack(size_raw)
+                crc = None
+                if self.version >= 2:
+                    crc_raw = f.read(4)
+                    if len(crc_raw) < 4:
+                        raise CorruptStateException(
+                            f"spill run {self.path}", "truncated block header"
+                        )
+                    (crc,) = _u32.unpack(crc_raw)
                 payload = f.read(nbytes)
                 if len(payload) < nbytes:
-                    raise ValueError(
-                        f"truncated spill run block in {self.path}"
+                    raise CorruptStateException(
+                        f"spill run {self.path}",
+                        f"torn block: expected {nbytes} bytes, "
+                        f"found {len(payload)}",
                     )
-                self.bytes_read += 8 + nbytes
+                if crc is not None and (
+                    zlib.crc32(payload) & 0xFFFFFFFF
+                ) != crc:
+                    raise CorruptStateException(
+                        f"spill run {self.path}", "block checksum mismatch"
+                    )
+                self.bytes_read += (12 if crc is not None else 8) + nbytes
                 yield decode_block(payload, self.n_cols)

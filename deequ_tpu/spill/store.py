@@ -95,6 +95,7 @@ class SpillingFrequencyStore:
         self._spill_dir = spill_dir
         self._tmpdir: Optional[str] = None
         self._finalizer = None
+        self._result_taken = False
         self._folder = StreamStateFolder()
         self._tail_bytes = 0
         self._all_canonical = True
@@ -299,6 +300,7 @@ class SpillingFrequencyStore:
     # -- finalize ------------------------------------------------------------
 
     def result(self) -> Optional[State]:
+        self._result_taken = True
         if not self._run_paths:
             # nothing spilled: plain state (or None). Rows folded in via
             # already-spilled INPUT states (whose blocks carry num_rows=0)
@@ -354,6 +356,24 @@ class SpillingFrequencyStore:
     def release(self) -> None:
         if self._finalizer is not None:
             self._finalizer()
+
+    # -- context manager -----------------------------------------------------
+    #
+    # ``with SpillingFrequencyStore(...) as store:`` guarantees the temp
+    # spill directory never outlives a FAILED run: an exception inside the
+    # block releases it immediately (instead of waiting on GC finalizers,
+    # which a crashing process may never run in a predictable order). A
+    # normal exit keeps the directory alive only when ``result()`` was
+    # taken — a SpilledFrequencies result streams its runs from that
+    # directory, so the consumer (or its weakref finalizer) owns cleanup.
+
+    def __enter__(self) -> "SpillingFrequencyStore":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None or not self._result_taken:
+            self.release()
+        return False
 
 
 class SpilledFrequencies(State):

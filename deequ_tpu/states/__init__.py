@@ -63,9 +63,10 @@ class FileSystemStateProvider(StateLoader, StatePersister):
 
     def __init__(self, location: str):
         from deequ_tpu.data.fs import filesystem_for, strip_scheme
+        from deequ_tpu.resilience.retry import RetryingFileSystem
 
         self.location = strip_scheme(location)
-        self._fs = filesystem_for(location)
+        self._fs = RetryingFileSystem(filesystem_for(location))
         self._fs.makedirs(self.location)
 
     def _path(self, analyzer: Analyzer) -> str:
@@ -73,20 +74,26 @@ class FileSystemStateProvider(StateLoader, StatePersister):
         return self._fs.join(self.location, f"{identifier}.state")
 
     def load(self, analyzer: Analyzer) -> Optional[State]:
+        from deequ_tpu.resilience.atomic import read_checksummed
         from deequ_tpu.states.serde import deserialize_state
 
         path = self._path(analyzer)
         if not self._fs.exists(path):
             return None
-        with self._fs.open(path, "rb") as f:
-            return deserialize_state(f.read())
+        # checksummed envelope (post-resilience files); legacy raw state
+        # blobs pass through read_checksummed unchanged
+        data = read_checksummed(self._fs, path, f"state file {path}")
+        return deserialize_state(data)
 
     def persist(self, analyzer: Analyzer, state: State) -> None:
+        from deequ_tpu.resilience.atomic import atomic_write_bytes, wrap_checksum
         from deequ_tpu.states.serde import serialize_state
 
-        data = serialize_state(state)
-        with self._fs.open(self._path(analyzer), "wb") as f:
-            f.write(data)
+        # atomic + checksummed: a crash mid-persist leaves the previous
+        # complete state; corruption is detected on load (CorruptState-
+        # Exception) instead of decoding garbage into a wrong metric
+        data = wrap_checksum(serialize_state(state))
+        atomic_write_bytes(self._fs, self._path(analyzer), data)
 
 
 # backwards-friendly alias mirroring the reference's name

@@ -25,11 +25,16 @@ from deequ_tpu.metrics import Metric
 
 @dataclass
 class VerificationResult:
-    """(reference VerificationResult.scala:33-119)"""
+    """(reference VerificationResult.scala:33-119)
+
+    ``skipped_batches`` lists the stream batch indices quarantined under
+    ``on_batch_error="skip"`` — the run's metrics exclude those rows, and
+    the omission is REPORTED here rather than silently dropped."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
     metrics: Dict[Analyzer, Metric]
+    skipped_batches: List[int] = field(default_factory=list)
 
     @staticmethod
     def success_metrics_as_rows(
@@ -121,7 +126,15 @@ class VerificationSuite:
         save_success_metrics_json_path: Optional[str] = None,
         overwrite_output_files: bool = False,
         group_memory_budget: Optional[int] = None,
+        checkpoint=None,
+        on_batch_error: str = "fail",
+        retry_policy=None,
     ) -> VerificationResult:
+        """Resilience knobs (streaming tables; deequ_tpu/resilience):
+        ``checkpoint`` (StreamCheckpointer or directory path) makes the
+        run resumable after a crash; ``on_batch_error="skip"`` quarantines
+        unreadable batches (reported on the result) instead of failing the
+        run; ``retry_policy`` overrides the batch-read RetryPolicy."""
         analyzers = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
@@ -136,6 +149,9 @@ class VerificationSuite:
             reuse_existing_results_for_key=reuse_existing_results_for_key,
             fail_if_results_missing=fail_if_results_missing,
             group_memory_budget=group_memory_budget,
+            checkpoint=checkpoint,
+            on_batch_error=on_batch_error,
+            retry_policy=retry_policy,
         )
 
         # evaluate BEFORE appending the new result: anomaly constraints query
@@ -214,7 +230,12 @@ class VerificationSuite:
                 (r.status for r in check_results.values()),
                 key=lambda s: s.severity,
             )
-        return VerificationResult(status, check_results, dict(analysis_context.metric_map))
+        return VerificationResult(
+            status,
+            check_results,
+            dict(analysis_context.metric_map),
+            list(getattr(analysis_context, "skipped_batches", ())),
+        )
 
     @staticmethod
     def _save_json_outputs(
@@ -334,6 +355,9 @@ class VerificationRunBuilder:
         self._success_metrics_path: Optional[str] = None
         self._overwrite_output_files = False
         self._group_memory_budget: Optional[int] = None
+        self._checkpoint = None
+        self._on_batch_error = "fail"
+        self._retry_policy = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -369,6 +393,45 @@ class VerificationRunBuilder:
         self._group_memory_budget = int(budget_bytes)
         return self
 
+    def with_checkpoint(
+        self, checkpoint, every_batches: Optional[int] = None
+    ) -> "VerificationRunBuilder":
+        """Make a streaming run resumable: every ``every_batches`` folded
+        batches the per-analyzer fold states persist (atomic +
+        checksummed) to ``checkpoint`` (a resilience.StreamCheckpointer or
+        a directory path); a rerun after a crash resumes from the last
+        valid checkpoint and yields bit-identical metrics
+        (docs/resilience.md)."""
+        from deequ_tpu.resilience.checkpoint import StreamCheckpointer
+
+        if isinstance(checkpoint, str):
+            checkpoint = StreamCheckpointer(
+                checkpoint, every_batches=every_batches or 8
+            )
+        elif every_batches is not None:
+            checkpoint.every_batches = int(every_batches)
+        self._checkpoint = checkpoint
+        return self
+
+    def on_batch_error(self, policy: str) -> "VerificationRunBuilder":
+        """Streaming batch-read failure policy: ``"fail"`` (default — a
+        batch whose reads exhaust retries fails the run's analyzers) or
+        ``"skip"`` (quarantine the batch; its index lands on
+        ``VerificationResult.skipped_batches``)."""
+        if policy not in ("fail", "skip"):
+            raise ValueError(
+                f"on_batch_error must be 'fail' or 'skip', got {policy!r}"
+            )
+        self._on_batch_error = policy
+        return self
+
+    def with_retry_policy(self, policy) -> "VerificationRunBuilder":
+        """Override the RetryPolicy for this run's batch reads
+        (resilience/retry.py; default: the table's policy, else the
+        process default)."""
+        self._retry_policy = policy
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -401,6 +464,9 @@ class VerificationRunBuilder:
             save_success_metrics_json_path=self._success_metrics_path,
             overwrite_output_files=self._overwrite_output_files,
             group_memory_budget=self._group_memory_budget,
+            checkpoint=self._checkpoint,
+            on_batch_error=self._on_batch_error,
+            retry_policy=self._retry_policy,
         )
 
 

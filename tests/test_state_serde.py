@@ -143,7 +143,12 @@ def test_file_system_provider_uses_binary(tmp_path):
     files = list(tmp_path.glob("*.state"))
     assert len(files) == 1
     raw = files[0].read_bytes()
-    assert raw[:4] == b"DQTS"  # binary format, not pickle
+    # checksum envelope (resilience/atomic.py) around the binary codec —
+    # never pickle
+    assert raw[:4] == b"DQX1"
+    from deequ_tpu.resilience import unwrap_checksum
+
+    assert unwrap_checksum(raw, "state")[:4] == b"DQTS"
     assert provider.load(Mean("x")) == MeanState(10.0, 4)
 
 
