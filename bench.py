@@ -127,6 +127,51 @@ def measure_checkpoint_overhead(n_rows: int):
     }
 
 
+def measure_oom_bisection_overhead(n_rows: int):
+    """Device-fault degradation cost probe: the same in-memory analysis
+    timed clean vs with a seeded device OOM injected on its first attempt
+    (forcing one chunk bisection — the scan restarts at half the chunk).
+    oom_bisection_overhead_frac = fraction of clean wall the bisected run
+    adds; the price of surviving an HBM OOM instead of dying on it."""
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.ops.device_policy import DEVICE_HEALTH
+    from deequ_tpu.ops.scan_engine import SCAN_STATS, install_scan_fault_hook
+    from deequ_tpu.resilience import FaultInjectingScanHook
+
+    table = build_table(n_rows)
+    analyzers = [Size()]
+    for i in range(4):
+        c = f"c{i}"
+        analyzers += [Completeness(c), Mean(c), Minimum(c), Maximum(c)]
+
+    def run(hook=None):
+        prev = install_scan_fault_hook(hook)
+        DEVICE_HEALTH.reset()
+        t0 = time.time()
+        try:
+            ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+        finally:
+            install_scan_fault_hook(prev)
+        wall = time.time() - t0
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        return wall
+
+    run()  # warmup: compile the fused program
+    clean = min(run(), run())
+    SCAN_STATS.reset()
+    bisected = min(
+        run(FaultInjectingScanHook(faults={0: ("oom", 1)})),
+        run(FaultInjectingScanHook(faults={0: ("oom", 1)})),
+    )
+    assert SCAN_STATS.oom_bisections >= 1, "probe failed to trigger bisection"
+    return {
+        "oom_bisection_overhead_frac": round(
+            max(bisected - clean, 0.0) / max(clean, 1e-9), 4
+        ),
+    }
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -206,9 +251,12 @@ def main():
         f"(v5e HBM peak ~819GB/s)",
         file=sys.stderr,
     )
-    # resilience-layer cost probe (small: 1/50th of the main config)
+    # resilience-layer cost probes (small: 1/50th of the main config)
     ckpt_probe = measure_checkpoint_overhead(SMOKE_ROWS if smoke else 200_000)
     print(f"checkpoint probe: {ckpt_probe}", file=sys.stderr)
+    oom_probe = measure_oom_bisection_overhead(SMOKE_ROWS if smoke else 200_000)
+    print(f"oom bisection probe: {oom_probe}", file=sys.stderr)
+    ckpt_probe = {**ckpt_probe, **oom_probe}
 
     if smoke:
         print(

@@ -64,6 +64,13 @@ from deequ_tpu.data.source import ParquetBatchSource  # noqa: E402
 from deequ_tpu.analyzers.incremental import (  # noqa: E402
     IncrementalAnalysisStream,
 )
+from deequ_tpu.exceptions import (  # noqa: E402
+    DeviceCompileException,
+    DeviceException,
+    DeviceHangException,
+    DeviceLostException,
+    DeviceOOMException,
+)
 from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
 from deequ_tpu.verification import (  # noqa: E402
     IncrementalVerificationStream,
@@ -94,6 +101,11 @@ __all__ = [
     "CheckLevel",
     "CheckStatus",
     "ColumnarTable",
+    "DeviceException",
+    "DeviceOOMException",
+    "DeviceCompileException",
+    "DeviceLostException",
+    "DeviceHangException",
     "DoubleMetric",
     "Entity",
     "HistogramMetric",

@@ -37,16 +37,15 @@ from deequ_tpu.analyzers.grouping import (
 )
 from deequ_tpu.data.table import ColumnarTable, Schema
 from deequ_tpu.exceptions import (
+    GroupBudgetIgnoredWarning,
     MetricCalculationRuntimeException,
+    ReusingNotPossibleResultsMissingException,  # noqa: F401 — canonical home
+    # is the exceptions taxonomy; re-exported here for compatibility (the
+    # class was born in this module)
     wrap_if_necessary,
 )
 from deequ_tpu.metrics import DoubleMetric, Metric
 from deequ_tpu.ops.scan_engine import run_scan
-
-
-class ReusingNotPossibleResultsMissingException(RuntimeError):
-    """Raised when fail_if_results_missing is set and the repository lacks
-    some requested analyzer results (reference AnalysisRunner.scala:552)."""
 
 
 @dataclass
@@ -167,6 +166,8 @@ class AnalysisRunner:
         checkpoint=None,
         on_batch_error: str = "fail",
         retry_policy=None,
+        on_device_error: str = "fail",
+        device_deadline=None,
     ) -> AnalyzerContext:
         """``group_memory_budget`` (bytes; also settable per-table via
         ``StreamingTable.with_group_memory_budget`` or the
@@ -182,7 +183,19 @@ class AnalysisRunner:
         metrics; ``on_batch_error="skip"`` quarantines batches whose reads
         keep failing past retries (indices reported on the context) instead
         of failing the run; ``retry_policy`` overrides the batch-read
-        RetryPolicy (default: the table's, else the process default)."""
+        RetryPolicy (default: the table's, else the process default).
+
+        Device faults (ops/device_policy.py + scan_engine.run_scan):
+        ``on_device_error="fallback"`` lets fused scans whose accelerator
+        OOMs below the bisection floor, fails to compile, is lost, or
+        hangs re-run on the CPU backend instead of failing their
+        analyzers (``"fail"``, the default, turns the typed exception
+        into failure metrics per the shared-scan rule); device OOMs
+        bisect the chunk size either way. ``device_deadline`` (seconds)
+        arms the compute watchdog around blocking device calls. A
+        streaming run with ``on_device_error="fallback"`` routes through
+        the resilient batch loop so each batch's scan gets the full
+        bisect/fallback policy."""
         if not analyzers:
             return AnalyzerContext.empty()
 
@@ -246,7 +259,9 @@ class AnalysisRunner:
         # need per-batch fold state on the host, so ALL analyzers share one
         # batch loop (fused per-batch scans for the scan-shareable set)
         if getattr(data, "is_streaming", False) and (
-            checkpoint is not None or on_batch_error != "fail"
+            checkpoint is not None
+            or on_batch_error != "fail"
+            or on_device_error != "fail"
         ):
             resilient_ctx = AnalysisRunner._run_streaming_resilient(
                 data, scanning, own_pass, by_grouping,
@@ -254,6 +269,8 @@ class AnalysisRunner:
                 group_memory_budget=group_memory_budget,
                 checkpoint=checkpoint, on_batch_error=on_batch_error,
                 retry_policy=retry_policy,
+                on_device_error=on_device_error,
+                device_deadline=device_deadline,
             )
             result = results_loaded + failure_ctx + resilient_ctx
             _save_or_append_result(
@@ -263,7 +280,8 @@ class AnalysisRunner:
 
         # (4) one fused scan for all shareable analyzers (reference L289-336)
         scan_ctx = AnalysisRunner._run_scanning_analyzers(
-            data, scanning, aggregate_with, save_states_with
+            data, scanning, aggregate_with, save_states_with,
+            on_device_error=on_device_error, device_deadline=device_deadline,
         )
 
         # own-pass analyzers (KLL extra pass analogue, reference L155-160);
@@ -409,6 +427,8 @@ class AnalysisRunner:
         data: ColumnarTable,
         analyzers: Sequence[ScanShareableAnalyzer],
         defer: bool = False,
+        on_device_error: str = "fail",
+        device_deadline=None,
     ):
         """Build + dispatch the fused scan. Returns (ctx_with_failures,
         scannable, plan, scan) where scan is the results list (or a
@@ -425,7 +445,11 @@ class AnalysisRunner:
             return ctx, [], [], None
         try:
             exec_ops, plan = AnalysisRunner._coalesce_scan_ops(ops)
-            scan = run_scan(data, exec_ops, defer=defer)
+            scan = run_scan(
+                data, exec_ops, defer=defer,
+                on_device_error=on_device_error,
+                device_deadline=device_deadline,
+            )
         except Exception as e:  # noqa: BLE001 — a failure inside the shared
             # scan maps onto every participating analyzer (reference L320-323)
             wrapped = wrap_if_necessary(e)
@@ -465,9 +489,15 @@ class AnalysisRunner:
         analyzers: Sequence[ScanShareableAnalyzer],
         aggregate_with=None,
         save_states_with=None,
+        on_device_error: str = "fail",
+        device_deadline=None,
     ) -> AnalyzerContext:
         ctx, scannable, plan, scan = (
-            AnalysisRunner._dispatch_scanning_analyzers(data, analyzers)
+            AnalysisRunner._dispatch_scanning_analyzers(
+                data, analyzers,
+                on_device_error=on_device_error,
+                device_deadline=device_deadline,
+            )
         )
         if scan is None:
             return ctx
@@ -569,6 +599,8 @@ class AnalysisRunner:
         checkpoint=None,
         on_batch_error: str = "fail",
         retry_policy=None,
+        on_device_error: str = "fail",
+        device_deadline=None,
     ) -> AnalyzerContext:
         """One resilient batch loop over the stream for EVERY analyzer
         class (scan-shareable / own-pass / grouping), with host-resident
@@ -629,12 +661,18 @@ class AnalysisRunner:
 
         budget = resolve_group_budget(data, group_memory_budget)
         if budget is not None and checkpoint is not None:
+            # ONE warn() per run: this method runs once per analysis run,
+            # never per batch. No filter overrides here — the typed
+            # category lets users suppress (filterwarnings ignore) or
+            # escalate (-W error) it; display dedup across runs is their
+            # filter policy, not ours.
             import warnings
 
             warnings.warn(
-                "group_memory_budget is ignored for checkpointed streaming "
-                "runs: spilled frequency state cannot be checkpointed; "
-                "frequency folds stay in host RAM",
+                "group_memory_budget is ignored for checkpointed "
+                "streaming runs: spilled frequency state cannot be "
+                "checkpointed; frequency folds stay in host RAM",
+                GroupBudgetIgnoredWarning,
                 stacklevel=2,
             )
             budget = None
@@ -760,7 +798,9 @@ class AnalysisRunner:
                 # batches via each op's analyzer cache_key (scan_engine)
                 sctx, scannable, plan, results = (
                     AnalysisRunner._dispatch_scanning_analyzers(
-                        batch, alive_scan
+                        batch, alive_scan,
+                        on_device_error=on_device_error,
+                        device_deadline=device_deadline,
                     )
                 )
                 failed.update(sctx.metric_map)

@@ -3,9 +3,19 @@
 Mirrors the reference semantics (analyzers/runners/MetricCalculationException.scala:19-78):
 failures during metric computation are *data* — they are captured inside
 ``Metric.value`` rather than aborting a run.
+
+Device faults are part of the same taxonomy: the scan engine classifies
+raw ``jaxlib``/``XlaRuntimeError`` failures at its three device
+boundaries (pack/transfer, trace/compile, execute) into the typed
+``Device*Exception`` family below, so callers — and the degradation
+policies (chunk bisection, CPU fallback, watchdog; ops/scan_engine.py) —
+never have to pattern-match runtime strings.
 """
 
 from __future__ import annotations
+
+import re
+from typing import Optional
 
 
 class MetricCalculationException(Exception):
@@ -62,6 +72,16 @@ class CorruptStateException(MetricCalculationRuntimeException):
         self.what = what
 
 
+class ReusingNotPossibleResultsMissingException(
+    MetricCalculationRuntimeException, RuntimeError
+):
+    """Raised when fail_if_results_missing is set and the repository lacks
+    some requested analyzer results (reference AnalysisRunner.scala:552).
+    Lives here so ALL failure types share one taxonomy; re-exported from
+    ``analyzers.runner`` for compatibility, and still a RuntimeError for
+    call sites that caught it as one before the move."""
+
+
 class RetryExhaustedException(MetricCalculationRuntimeException):
     """A retried I/O operation kept failing past the RetryPolicy's attempt
     budget or deadline. ``__cause__`` carries the last underlying error."""
@@ -72,6 +92,142 @@ class RetryExhaustedException(MetricCalculationRuntimeException):
         )
         self.attempts = attempts
         self.__cause__ = cause
+
+
+class GroupBudgetIgnoredWarning(UserWarning):
+    """``group_memory_budget`` was configured together with checkpointing:
+    mid-store spill state is not serializable, so spill is disabled and
+    frequency folds stay in host RAM. Emitted exactly ONCE per analysis
+    run (never per batch); the typed category lets deployments suppress
+    or escalate it through the standard warnings filters."""
+
+
+# -- device fault taxonomy ---------------------------------------------------
+#
+# Spark gives the reference fault tolerance for free (lost tasks re-execute
+# from lineage); JAX/XLA gives us raw RuntimeErrors with status-code
+# prefixes. The scan engine classifies them ONCE, at the device boundary
+# where they surfaced, into this typed family — the degradation policies
+# (bisection/fallback/watchdog) and user code both dispatch on types.
+
+#: the three device boundaries where classification happens
+DEVICE_BOUNDARIES = ("transfer", "trace", "execute")
+
+
+class DeviceException(MetricCalculationRuntimeException):
+    """A classified device-layer (XLA/jaxlib) failure.
+
+    ``boundary`` names where it surfaced: ``"transfer"`` (device_put /
+    chunk pack), ``"trace"`` (jit trace / compile), or ``"execute"``
+    (dispatch / block_until_ready / result fetch)."""
+
+    def __init__(self, message: str, boundary: str = "execute"):
+        super().__init__(message)
+        self.boundary = boundary
+
+
+class DeviceOOMException(DeviceException):
+    """Device memory (HBM) exhausted — RESOURCE_EXHAUSTED / allocator
+    failures. Recoverable by scanning in smaller chunks (the engine's
+    adaptive chunk bisection) or by falling back to the host backend."""
+
+
+class DeviceCompileException(DeviceException):
+    """The fused program failed to lower/compile for the accelerator
+    (INVALID_ARGUMENT / UNIMPLEMENTED / Mosaic or XLA compilation errors).
+    Retrying the same program on the same backend cannot help; the CPU
+    fallback re-jits it on the host backend."""
+
+
+class DeviceLostException(DeviceException):
+    """The accelerator died or never came up: backend initialization
+    failures, device halts, DATA_LOSS / UNAVAILABLE / ABORTED / INTERNAL
+    runtime states. The run can only continue on another backend."""
+
+
+class DeviceHangException(DeviceException):
+    """A blocking device call exceeded the compute watchdog's wall-clock
+    deadline — a hung device converted into a typed, catchable failure
+    (the blocked host thread is abandoned; it cannot be cancelled)."""
+
+    def __init__(self, message: str, boundary: str = "execute",
+                 deadline: Optional[float] = None):
+        super().__init__(message, boundary)
+        self.deadline = deadline
+
+
+# message patterns per class, checked in order — OOM first (an OOM during
+# compilation must bisect, not fall back), then compile, then lost
+_OOM_RE = re.compile(
+    r"RESOURCE_EXHAUSTED|[Oo]ut of memory|\bOOM\b|[Aa]llocation.*"
+    r"(failed|exceeds)|[Ff]ailed to allocate|HBM.*exceed", re.DOTALL
+)
+_COMPILE_RE = re.compile(
+    r"INVALID_ARGUMENT|UNIMPLEMENTED|[Cc]ompilation (failure|error)|"
+    r"[Ff]ailed to compile|Mosaic|XLA can't deduce|[Ll]owering",
+    re.DOTALL,
+)
+_LOST_RE = re.compile(
+    r"DATA_LOSS|UNAVAILABLE|ABORTED|INTERNAL|DEADLINE_EXCEEDED|"
+    r"[Dd]evice.*(lost|halt|reset)|[Uu]nable to initialize backend|"
+    r"[Ff]ailed to initialize|[Nn]o visible.*devic|TPU.*unavailable",
+    re.DOTALL,
+)
+
+
+def _device_error_strength(exception: BaseException) -> Optional[str]:
+    """``"strong"`` when the exception TYPE is device-shaped (jaxlib
+    surfaces runtime failures as XlaRuntimeError, a RuntimeError from the
+    jaxlib/jax modules — checked structurally so no jaxlib import is
+    needed and test doubles with the same shape classify identically);
+    ``"weak"`` for plain RuntimeError/MemoryError, which only classify on
+    an unambiguous message pattern; None for everything else."""
+    for klass in type(exception).__mro__:
+        if klass.__name__ in (
+            "XlaRuntimeError", "JaxRuntimeError", "InternalError"
+        ):
+            return "strong"
+        module = getattr(klass, "__module__", "") or ""
+        if module.startswith(("jaxlib", "jax.")) or module == "jax":
+            return "strong"
+    if isinstance(exception, (RuntimeError, MemoryError)):
+        return "weak"
+    return None
+
+
+def classify_device_error(
+    exception: BaseException, boundary: str = "execute"
+) -> Optional[DeviceException]:
+    """Map a raw device-layer error to its typed DeviceException, or None
+    when the error is not device-shaped (logic errors must propagate
+    untouched). Already-classified exceptions pass through unchanged.
+
+    A plain RuntimeError with no recognizable status pattern stays
+    unclassified even at the trace boundary — application bugs raised
+    inside an op's update fn must surface as themselves, not trigger a
+    pointless CPU fallback under a misleading device-fault type."""
+    if isinstance(exception, DeviceException):
+        return exception
+    strength = _device_error_strength(exception)
+    if strength is None:
+        return None
+    text = f"{type(exception).__name__}: {exception}"
+    klass = None
+    if isinstance(exception, MemoryError) or _OOM_RE.search(text):
+        klass = DeviceOOMException
+    elif _COMPILE_RE.search(text):
+        klass = DeviceCompileException
+    elif _LOST_RE.search(text):
+        klass = DeviceLostException
+    elif boundary == "trace" and strength == "strong":
+        # an unrecognized jax/jaxlib failure while tracing/compiling is a
+        # compile failure by position: the program never ran
+        klass = DeviceCompileException
+    if klass is None:
+        return None
+    typed = klass(f"[{boundary}] {text}", boundary=boundary)
+    typed.__cause__ = exception
+    return typed
 
 
 def wrap_if_necessary(exception: BaseException) -> MetricCalculationException:

@@ -1,0 +1,190 @@
+"""Device-fault policy for the scan engine — boundary classification,
+compute watchdog, fault-injection seam, and backend health.
+
+The reference inherits fault tolerance from Spark (a lost task re-executes
+from lineage, so deequ never sees the fault); native-compilation engines
+that trade that recovery model for speed get nothing (Flare,
+arXiv:1703.08219). This module is the engine-side half of ours:
+
+- :func:`device_call` wraps every blocking device call at one of the
+  three boundaries (``transfer`` / ``trace`` / ``execute``), converting
+  raw jaxlib errors into the typed taxonomy (``exceptions.py``) and —
+  when a wall-clock ``deadline`` is set — running the call on a watchdog
+  worker thread so a HUNG device becomes a typed
+  ``DeviceHangException`` instead of a frozen run;
+- :func:`install_scan_fault_hook` is the deterministic injection seam the
+  resilience tests drive (``resilience/faults.py:FaultInjectingScanHook``);
+- :class:`DeviceHealth` counts classified faults so a backend that
+  REPEATEDLY faults routes subsequent scans straight to the CPU fallback
+  instead of re-failing first every time.
+
+The degradation policies themselves (chunk bisection, CPU re-jit) live in
+``ops/scan_engine.py:run_scan`` — this module only decides *what* failed
+and *whether* the backend is still trusted.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from deequ_tpu.exceptions import (
+    DeviceException,
+    DeviceHangException,
+    classify_device_error,
+)
+
+# -- fault-injection seam ----------------------------------------------------
+
+# The installed hook is called as hook(boundary, ctx) immediately before
+# the wrapped device call runs (INSIDE the watchdog, so injected hangs are
+# converted like real ones). ctx carries {"scan_id", "attempt",
+# "chunk_index", "fallback"} — see FaultInjectingScanHook.
+_SCAN_FAULT_HOOK: Optional[Callable[[str, Dict[str, Any]], None]] = None
+
+
+def install_scan_fault_hook(hook) -> Optional[Callable]:
+    """Install (or, with None, remove) the scan-engine fault hook.
+    Returns the previously installed hook so tests can restore it."""
+    global _SCAN_FAULT_HOOK
+    previous = _SCAN_FAULT_HOOK
+    _SCAN_FAULT_HOOK = hook
+    return previous
+
+
+def current_scan_fault_hook():
+    return _SCAN_FAULT_HOOK
+
+
+# -- compute watchdog --------------------------------------------------------
+
+
+def default_device_deadline() -> Optional[float]:
+    """Process-wide watchdog deadline (seconds) from
+    ``DEEQU_TPU_DEVICE_DEADLINE``; unset/empty/0 disables the watchdog."""
+    raw = os.environ.get("DEEQU_TPU_DEVICE_DEADLINE", "")
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    return val if val > 0 else None
+
+
+def _call_with_deadline(fn: Callable, deadline: float, what: str,
+                        boundary: str):
+    """Run ``fn`` on a watchdog worker thread; if it does not finish
+    within ``deadline`` seconds, raise DeviceHangException. The blocked
+    thread is a daemon and is abandoned — a genuinely hung device call
+    cannot be cancelled from Python, only *detected*."""
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name="deequ-tpu-watchdog")
+    t.start()
+    if not done.wait(deadline):
+        raise DeviceHangException(
+            f"[{boundary}] {what} exceeded the {deadline:g}s compute "
+            "watchdog deadline — treating the device as hung",
+            boundary=boundary,
+            deadline=deadline,
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def device_call(
+    fn: Callable,
+    boundary: str,
+    what: str = "device call",
+    deadline: Optional[float] = None,
+    hook_ctx: Optional[Dict[str, Any]] = None,
+):
+    """Run one device-boundary call under classification (+ optional
+    watchdog + optional fault injection).
+
+    Raw jaxlib/XLA failures re-raise as their typed DeviceException (with
+    ``__cause__`` preserved); non-device errors propagate untouched.
+    ``hook_ctx`` is passed only at the execute seam — the one place the
+    deterministic fault hook fires.
+
+    Cost note: an armed deadline spawns one short-lived watchdog thread
+    per call (~0.1ms) — noise next to a device round trip, but reason
+    enough that the watchdog is opt-in and off by default."""
+    hook = _SCAN_FAULT_HOOK if hook_ctx is not None else None
+
+    def body():
+        if hook is not None:
+            hook(boundary, hook_ctx)
+        return fn()
+
+    try:
+        if deadline is not None:
+            return _call_with_deadline(body, deadline, what, boundary)
+        return body()
+    except DeviceException:
+        raise
+    except Exception as e:  # noqa: BLE001 — classified below; non-device
+        # errors (logic bugs, KeyboardInterrupt is not an Exception)
+        # propagate exactly as before
+        typed = classify_device_error(e, boundary)
+        if typed is not None:
+            raise typed from e
+        raise
+
+
+# -- backend health ----------------------------------------------------------
+
+
+class DeviceHealth:
+    """Consecutive-fault counter for the accelerator backend.
+
+    After ``threshold`` consecutive classified device faults with no
+    successful device pass in between, ``should_force_fallback()`` turns
+    true and scans running with ``on_device_error="fallback"`` go
+    STRAIGHT to the CPU backend — a flapping device must not re-fail
+    every scan before each fallback. Forced fallback is never permanent:
+    every ``probe_interval``-th forced scan probes the accelerator again
+    (half-open, circuit-breaker style), and one successful accelerator
+    pass resets the counter — transient weather forgives. Faults observed
+    ON the CPU fallback attempt are the host's, not the accelerator's,
+    and must not be recorded here."""
+
+    def __init__(self, threshold: int = 3, probe_interval: int = 8):
+        self.threshold = int(threshold)
+        self.probe_interval = int(probe_interval)
+        self.reset()
+
+    def reset(self) -> None:
+        self.consecutive_faults = 0
+        self.total_faults = 0
+        self._forced = 0
+
+    def record_fault(self, exc: DeviceException) -> None:
+        self.consecutive_faults += 1
+        self.total_faults += 1
+
+    def record_success(self) -> None:
+        self.consecutive_faults = 0
+        self._forced = 0
+
+    def should_force_fallback(self) -> bool:
+        if self.consecutive_faults < self.threshold:
+            return False
+        self._forced += 1
+        if self.probe_interval and self._forced % self.probe_interval == 0:
+            return False  # half-open probe: try the accelerator this once
+        return True
+
+
+#: process-wide accelerator health, read by run_scan's fallback policy
+DEVICE_HEALTH = DeviceHealth()

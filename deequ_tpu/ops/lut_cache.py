@@ -46,9 +46,15 @@ def _mesh_value_key(mesh):
     """Meshes are keyed by VALUE (shape + axis names + device list), never
     by object identity: default_mesh() builds a fresh (equal) Mesh per run,
     and an id() key would both miss every run and risk aliasing a GC'd
-    mesh's recycled id."""
+    mesh's recycled id. With no mesh, the key carries the default-device
+    override: the CPU-fallback path (scan_engine) runs under
+    ``jax.default_device(cpu)``, and a memoized array COMMITTED to the
+    accelerator must not be handed to a scan that is fleeing it."""
     if mesh is None:
-        return None
+        import jax
+
+        default = getattr(jax.config, "jax_default_device", None)
+        return None if default is None else ("default_device", str(default))
     return (mesh.devices.shape, tuple(mesh.axis_names), tuple(mesh.devices.flat))
 
 

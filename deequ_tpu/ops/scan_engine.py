@@ -27,6 +27,7 @@ elementwise max, DataType histogram via vector sum). KLL gets its own pass
 
 from __future__ import annotations
 
+import itertools
 import math
 import weakref
 from dataclasses import dataclass, field
@@ -39,7 +40,19 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.exceptions import (
+    DeviceException,
+    DeviceHangException,
+    DeviceOOMException,
+    classify_device_error,
+)
 from deequ_tpu.expr.eval import Val
+from deequ_tpu.ops.device_policy import (
+    DEVICE_HEALTH,
+    default_device_deadline,
+    device_call,
+    install_scan_fault_hook,  # noqa: F401 — re-exported: the seam lives here
+)
 from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh, shard_map
 
 DEFAULT_CHUNK_ROWS = 1 << 20
@@ -161,9 +174,34 @@ class ScanStats:
         self.spill_bytes_read = 0
         self.spill_merge_passes = 0
         self.peak_group_state_bytes = 0
+        # device-fault tolerance (ops/device_policy.py + run_scan's
+        # bisection/fallback driver): classified device faults seen,
+        # OOM-driven chunk halvings, the deepest bisection any single scan
+        # needed, watchdog conversions of hung calls, scans that completed
+        # on the CPU fallback backend (and which backend that was), and a
+        # structured log of every degradation decision
+        self.device_faults = 0
+        self.oom_bisections = 0
+        self.bisection_depth = 0
+        self.watchdog_timeouts = 0
+        self.fallback_scans = 0
+        self.fallback_backend = None
+        self.degradation_events = []
 
     def snapshot(self) -> dict:
-        return dict(self.__dict__)
+        snap = dict(self.__dict__)
+        # events are mutable rows — hand out a copy so a caller's report
+        # is a point-in-time record, not a live view
+        snap["degradation_events"] = [dict(e) for e in self.degradation_events]
+        return snap
+
+    def record_degradation(self, kind: str, **detail) -> dict:
+        """Append one degradation decision (kind: 'oom_bisect' |
+        'cpu_fallback' | 'watchdog_timeout' | 'device_fault') for
+        execution reports and VerificationResult.device_events."""
+        event = {"kind": kind, **detail}
+        self.degradation_events.append(event)
+        return event
 
     def effective_bytes_per_sec(self) -> float:
         """Scanned bytes per wall second across all passes (compare to the
@@ -857,8 +895,17 @@ class _PartialFolder:
         import time as _time
 
         t0 = _time.time()
-        flat = np.asarray(device_result)
-        SCAN_STATS.drain_wait_seconds += _time.time() - t0
+        try:
+            flat = np.asarray(device_result)
+        except Exception as e:  # noqa: BLE001 — async device failures
+            # (OOM, device loss) surface HERE, at the fetch: classify once
+            # so every drain path (inline, deferred, grouped) raises typed
+            typed = classify_device_error(e, "execute")
+            if typed is not None:
+                raise typed from e
+            raise
+        finally:
+            SCAN_STATS.drain_wait_seconds += _time.time() - t0
         partials = _unflatten_partials(flat, self.shapes)
         SCAN_STATS.chunks_processed += 1
         if self.merged is None:
@@ -984,12 +1031,52 @@ def fetch_deferred(scans: Sequence["DeferredScan"]) -> None:
     SCAN_STATS.scan_seconds += _time.time() - t0
 
 
+# smallest chunk the OOM bisection will try before giving up: below this
+# the per-chunk dispatch overhead dominates and an OOM is no longer about
+# chunk size (something else holds the HBM). log2(MAX_CHUNK_ROWS/64) = 17
+# bounds the halvings of any single scan
+MIN_BISECT_CHUNK_ROWS = 64
+
+# one id per logical run_scan call, stable across bisection/fallback
+# retries — the key the deterministic fault hook scripts against
+_SCAN_IDS = itertools.count()
+
+
+def _cpu_fallback_device():
+    """The CPU device the fallback re-jits on, or None when the process
+    has no CPU backend (e.g. JAX_PLATFORMS pinned to the accelerator
+    only) — then the typed device error propagates instead of a
+    confusing secondary backend-lookup failure."""
+    try:
+        return jax.devices("cpu")[0]
+    except Exception:  # noqa: BLE001 — backend not registered
+        return None
+
+
+def _evict_device_cache(table) -> int:
+    """Free a persisted table's HBM residency (the first response to a
+    device OOM: the resident chunks are the biggest HBM tenant). Returns
+    the bytes released."""
+    cache = getattr(table, "_device_cache", None)
+    if cache is None:
+        return 0
+    freed = cache.nbytes
+    # drop the buffers eagerly — the WeakSet entry dies with the cache,
+    # but the device arrays must not wait for a GC cycle mid-OOM
+    cache.device_chunks = []
+    cache.programs.clear()
+    table._device_cache = None
+    return freed
+
+
 def run_scan(
     table,
     ops: Sequence[ScanOp],
     chunk_rows: Optional[int] = None,
     mesh=None,
     defer: bool = False,
+    on_device_error: str = "fail",
+    device_deadline: Optional[float] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -997,16 +1084,169 @@ def run_scan(
     Returns one reduced numpy pytree per op — or, with ``defer=True`` (in-
     memory tables only), a ``DeferredScan`` whose ``result()`` fetches
     them later.
+
+    Device-fault policy (in-memory tables; ops/device_policy.py):
+
+    - raw jaxlib/XLA failures at the pack/transfer, trace, and execute
+      boundaries raise as typed ``Device*Exception``s;
+    - a ``DeviceOOMException`` evicts the table's HBM residency, halves
+      the chunk row count, and retries — down to ``MIN_BISECT_CHUNK_ROWS``
+      — so the fused pass degrades to more, smaller device steps instead
+      of an OOM cliff (each halving is a recorded degradation event);
+    - ``on_device_error="fallback"`` re-runs the same fused program on the
+      CPU backend when the accelerator fails to compile, is lost, hangs,
+      or OOMs below the bisection floor (states are backend-agnostic
+      monoids, so results match the accelerator's); ``"fail"`` (default)
+      raises the typed exception;
+    - ``device_deadline`` (seconds; default from
+      ``DEEQU_TPU_DEVICE_DEADLINE``) arms the compute watchdog: a blocking
+      device call that exceeds it raises ``DeviceHangException`` instead
+      of hanging the run.
+
+    ``defer=True`` scans dispatch under the same typed boundaries, but
+    errors surfacing at ``result()`` are past bisection/fallback — the
+    caller holds the only retry point then.
     """
+    if on_device_error not in ("fail", "fallback"):
+        raise ValueError(
+            f"on_device_error must be 'fail' or 'fallback', "
+            f"got {on_device_error!r}"
+        )
     if mesh is None:
         mesh = current_mesh()
+    if device_deadline is None:
+        device_deadline = default_device_deadline()
+    scan_id = next(_SCAN_IDS)
     if getattr(table, "is_streaming", False):
         if defer:
             raise ValueError(
                 "defer=True is for in-memory batch tables; streaming scans "
                 "already pipeline internally"
             )
-        return _run_scan_stream(table, ops, chunk_rows, mesh)
+        return _run_scan_stream(
+            table, ops, chunk_rows, mesh,
+            scan_id=scan_id, device_deadline=device_deadline,
+        )
+
+    chunk_override = chunk_rows
+    attempt = 0
+    n_dev = math.prod(mesh.devices.shape) if mesh is not None else 1
+    floor = max(n_dev, min(MIN_BISECT_CHUNK_ROWS, max(table.num_rows, 1)))
+    # fallback needs a CPU backend to land on; a process pinned to the
+    # accelerator platform only degrades to raising the typed error
+    can_fallback = (
+        on_device_error == "fallback" and _cpu_fallback_device() is not None
+    )
+    # can_fallback first: should_force_fallback() advances the half-open
+    # probe counter and must not run for on_device_error="fail" scans
+    fallback = can_fallback and DEVICE_HEALTH.should_force_fallback()
+    if fallback:
+        SCAN_STATS.record_degradation(
+            "cpu_fallback", scan_id=scan_id, reason="unhealthy_backend",
+            consecutive_faults=DEVICE_HEALTH.consecutive_faults,
+        )
+    depth = 0
+    while True:
+        scan_ctx = {
+            "scan_id": scan_id, "attempt": attempt, "fallback": fallback,
+        }
+        report: Dict[str, Any] = {}
+        try:
+            if fallback:
+                SCAN_STATS.fallback_scans += 1
+                SCAN_STATS.fallback_backend = "cpu"
+                # the resident chunks (and on single-device setups even a
+                # mesh=None cache) are committed to the ACCELERATOR —
+                # jax.default_device cannot move committed arrays, so the
+                # fallback must drop residency or it would dispatch right
+                # back onto the device it is fleeing
+                _evict_device_cache(table)
+                with jax.default_device(_cpu_fallback_device()):
+                    # the watchdog disarms on the fallback attempt: it
+                    # exists to detect a hung ACCELERATOR, and the CPU
+                    # re-jit legitimately pays a fresh compile the
+                    # accelerator deadline was never sized for
+                    return _run_scan_once(
+                        table, ops, chunk_override, None, defer,
+                        None, scan_ctx, report,
+                    )
+            result = _run_scan_once(
+                table, ops, chunk_override, mesh, defer,
+                device_deadline, scan_ctx, report,
+            )
+            DEVICE_HEALTH.record_success()
+            return result
+        except DeviceOOMException as e:
+            SCAN_STATS.device_faults += 1
+            if not fallback:  # CPU-side faults are not accelerator health
+                DEVICE_HEALTH.record_fault(e)
+            used = report.get("chunk") or chunk_override or DEFAULT_CHUNK_ROWS
+            freed = _evict_device_cache(table)
+            halved = max(floor, used // 2)
+            halved = max(n_dev, (halved // n_dev) * n_dev)
+            if halved < used and not fallback:
+                depth += 1
+                SCAN_STATS.oom_bisections += 1
+                SCAN_STATS.bisection_depth = max(
+                    SCAN_STATS.bisection_depth, depth
+                )
+                SCAN_STATS.record_degradation(
+                    "oom_bisect", scan_id=scan_id, chunk_from=int(used),
+                    chunk_to=int(halved), depth=depth, evicted_bytes=freed,
+                    error=str(e),
+                )
+                chunk_override = halved
+                attempt += 1
+                continue
+            # at the floor (or already on the fallback backend): bisection
+            # cannot help any further
+            if can_fallback and not fallback:
+                fallback = True
+                attempt += 1
+                SCAN_STATS.record_degradation(
+                    "cpu_fallback", scan_id=scan_id,
+                    reason="oom_at_bisection_floor", chunk=int(used),
+                    error=str(e),
+                )
+                continue
+            raise
+        except DeviceException as e:
+            # compile / lost / hang: retrying the same program on the same
+            # backend cannot help — fall back or raise typed
+            SCAN_STATS.device_faults += 1
+            if not fallback:  # CPU-side faults are not accelerator health
+                DEVICE_HEALTH.record_fault(e)
+            if isinstance(e, DeviceHangException):
+                SCAN_STATS.watchdog_timeouts += 1
+                SCAN_STATS.record_degradation(
+                    "watchdog_timeout", scan_id=scan_id,
+                    deadline=e.deadline, error=str(e),
+                )
+            if can_fallback and not fallback:
+                fallback = True
+                attempt += 1
+                SCAN_STATS.record_degradation(
+                    "cpu_fallback", scan_id=scan_id,
+                    reason=type(e).__name__, error=str(e),
+                )
+                continue
+            raise
+
+
+def _run_scan_once(
+    table,
+    ops: Sequence[ScanOp],
+    chunk_rows: Optional[int],
+    mesh,
+    defer: bool,
+    device_deadline: Optional[float],
+    scan_ctx: Dict[str, Any],
+    report: Dict[str, Any],
+) -> List[Any]:
+    """One attempt of the fused in-memory scan (the pre-fault-tolerance
+    run_scan body, instrumented at the three device boundaries).
+    ``report`` returns the chunk size actually used so the bisection
+    driver can halve it."""
     n_rows = table.num_rows
     needed = sorted({c for op in ops for c in op.columns})
     cols = {name: table[name] for name in needed}
@@ -1032,6 +1272,7 @@ def run_scan(
         # static shapes: round the chunk up so it splits evenly across devices
         chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
         packer = _ChunkPacker(cols, chunk)
+    report["chunk"] = chunk
     local_n = chunk // n_dev if mesh is not None else chunk
 
     # dictionary LUTs ship once (memoized device arrays) and enter the
@@ -1092,18 +1333,32 @@ def run_scan(
     if cache is not None:
         SCAN_STATS.resident_passes += 1
         SCAN_STATS.bytes_resident += cache.nbytes
-        for args in cache.device_chunks:
+        for ci, args in enumerate(cache.device_chunks):
             if folder.shapes is None:
-                folder.shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
+                folder.shapes = device_call(
+                    lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
+                    "trace", what="fused-scan trace",
+                )
                 if prog_key is not None:
                     cache.put_program(prog_key, (step_fn, folder.shapes))
                 if global_key is not None:
                     _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
             t_d = _time.time()
-            in_flight.append(step_fn(*args, lut_arrays))
+            in_flight.append(
+                device_call(
+                    lambda: step_fn(*args, lut_arrays),
+                    "execute", what=f"chunk {ci} dispatch",
+                    deadline=device_deadline,
+                    hook_ctx={**scan_ctx, "chunk_index": ci},
+                )
+            )
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
-                folder.drain(in_flight.pop(0))
+                device_call(
+                    lambda: folder.drain(in_flight.pop(0)),
+                    "execute", what=f"chunk drain (window at {ci})",
+                    deadline=device_deadline,
+                )
     else:
         for ci in range(n_chunks):
             start = ci * chunk
@@ -1111,18 +1366,42 @@ def run_scan(
             args = packer.pack(start, stop)
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
             if folder.shapes is None:
-                folder.shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
+                folder.shapes = device_call(
+                    lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
+                    "trace", what="fused-scan trace",
+                )
                 if global_key is not None:
                     _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
             t_d = _time.time()
-            in_flight.append(step_fn(*put(args), lut_arrays))
+            device_args = device_call(
+                lambda: put(args), "transfer",
+                what=f"chunk {ci} transfer", deadline=device_deadline,
+            )
+            in_flight.append(
+                device_call(
+                    lambda: step_fn(*device_args, lut_arrays),
+                    "execute", what=f"chunk {ci} dispatch",
+                    deadline=device_deadline,
+                    hook_ctx={**scan_ctx, "chunk_index": ci},
+                )
+            )
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
-                folder.drain(in_flight.pop(0))
+                device_call(
+                    lambda: folder.drain(in_flight.pop(0)),
+                    "execute", what=f"chunk drain (window at {ci})",
+                    deadline=device_deadline,
+                )
     deferred = DeferredScan(folder, in_flight, t_start, bill_from_start=not defer)
     if defer:
         return deferred
-    return deferred.result()
+    # the drain is the blocking device round trip — the watchdog's prime
+    # target (folder.drain classifies fetch errors; device_call adds the
+    # hang deadline on top)
+    return device_call(
+        deferred.result, "execute", what="scan drain",
+        deadline=device_deadline,
+    )
 
 
 # -- micro-batched group scan (incremental pipelines) -----------------------
@@ -1489,6 +1768,8 @@ def _run_scan_stream(
     ops: Sequence[ScanOp],
     chunk_rows: Optional[int],
     mesh,
+    scan_id: int = -1,
+    device_deadline: Optional[float] = None,
 ) -> List[Any]:
     """One fused pass over a StreamingTable: batches stream off storage on
     a reader thread, pack into fixed-size chunks, and dispatch with a small
@@ -1499,7 +1780,14 @@ def _run_scan_stream(
 
     The packer layout is pinned on the first batch so the traced program is
     reused across every numeric batch of the stream (string columns bake
-    per-batch dictionaries into the trace and retrace per batch)."""
+    per-batch dictionaries into the trace and retrace per batch).
+
+    Device failures raise TYPED (exceptions.py taxonomy) but are not
+    bisected/fallback-retried here: a half-consumed stream cannot be
+    re-read. Streaming runs wanting per-batch device-fault recovery go
+    through the runner's resilient loop (``on_device_error`` /
+    ``on_batch_error`` / ``checkpoint``), which scans each batch as an
+    in-memory table under the full policy."""
     needed = sorted({c for op in ops for c in op.columns})
     schema = stream.schema
     dtypes = {n: schema[n].dtype for n in needed}
@@ -1531,6 +1819,7 @@ def _run_scan_stream(
     folder = _PartialFolder(ops)
     in_flight = []
     window = 3
+    chunk_counter = [0]
     layout: Optional[dict] = None
     # the current (layout, lut signature)'s (step_fn, shapes); reset when
     # either changes (layout upgrades are sticky; LUT shapes change only
@@ -1597,7 +1886,10 @@ def _run_scan_stream(
             args = packer.pack(start, stop)
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
             if shapes is None:
-                shapes = jax.eval_shape(shape_fn, *args, lut_arrays)
+                shapes = device_call(
+                    lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
+                    "trace", what="fused-stream trace",
+                )
                 if not baked:
                     current_prog = (sig, step_fn, shapes)
                     if global_key is not None:
@@ -1605,10 +1897,31 @@ def _run_scan_stream(
             if folder.shapes is None:
                 folder.shapes = shapes
             t_d = _time.time()
-            in_flight.append(step_fn(*put(args), lut_arrays))
+            device_args = device_call(
+                lambda: put(args), "transfer",
+                what=f"stream chunk {chunk_counter[0]} transfer",
+                deadline=device_deadline,
+            )
+            in_flight.append(
+                device_call(
+                    lambda: step_fn(*device_args, lut_arrays),
+                    "execute",
+                    what=f"stream chunk {chunk_counter[0]} dispatch",
+                    deadline=device_deadline,
+                    hook_ctx={
+                        "scan_id": scan_id, "attempt": 0, "fallback": False,
+                        "chunk_index": chunk_counter[0],
+                    },
+                )
+            )
+            chunk_counter[0] += 1
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
             if len(in_flight) >= window:
-                folder.drain(in_flight.pop(0))
+                device_call(
+                    lambda: folder.drain(in_flight.pop(0)),
+                    "execute", what="stream chunk drain",
+                    deadline=device_deadline,
+                )
             if stop >= n:
                 break
 
@@ -1623,6 +1936,9 @@ def _run_scan_stream(
         process_cols(_empty_batch_cols(schema, needed), 0)
 
     for device_result in in_flight:
-        folder.drain(device_result)
+        device_call(
+            lambda: folder.drain(device_result),
+            "execute", what="stream tail drain", deadline=device_deadline,
+        )
     SCAN_STATS.scan_seconds += _time.time() - t_start
     return folder.merged

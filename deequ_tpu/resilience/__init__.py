@@ -16,12 +16,25 @@ transient reads, torn writes, and mid-stream crashes:
   from its last checkpoint;
 - :mod:`deequ_tpu.resilience.faults` — the deterministic seeded
   fault-injection harness (``FaultInjectingFileSystem``,
-  ``FlakyBatchSource``) the resilience test suite drives.
+  ``FlakyBatchSource``, and the device-fault ``FaultInjectingScanHook``)
+  the resilience test suites drive.
+
+Device-side fault tolerance (the XLA error taxonomy, OOM chunk
+bisection, the CPU fallback, and the compute watchdog) lives in
+``deequ_tpu/exceptions.py`` + ``deequ_tpu/ops/device_policy.py`` +
+``ops/scan_engine.py:run_scan``; this package supplies its injection
+harness and shares the quarantine/checkpoint machinery it composes with.
 """
 
 from deequ_tpu.exceptions import (  # noqa: F401 — canonical home is exceptions
     CorruptStateException,
+    DeviceCompileException,
+    DeviceException,
+    DeviceHangException,
+    DeviceLostException,
+    DeviceOOMException,
     RetryExhaustedException,
+    classify_device_error,
 )
 from deequ_tpu.resilience.atomic import (
     atomic_write_bytes,
@@ -38,15 +51,19 @@ from deequ_tpu.resilience.checkpoint import (
 )
 from deequ_tpu.resilience.faults import (
     FaultInjectingFileSystem,
+    FaultInjectingScanHook,
     FaultSchedule,
     FlakyBatchSource,
+    InjectedDeviceError,
     InjectedIOError,
 )
 from deequ_tpu.resilience.retry import (
     DEFAULT_IO_RETRY,
+    RETRY_TELEMETRY,
     RetryingBatchSource,
     RetryingFileSystem,
     RetryPolicy,
+    RetryTelemetry,
     default_retry_policy,
     resilient_batches,
     resolve_retry_policy,
@@ -56,7 +73,15 @@ from deequ_tpu.resilience.retry import (
 
 __all__ = [
     "CorruptStateException",
+    "DeviceException",
+    "DeviceOOMException",
+    "DeviceCompileException",
+    "DeviceLostException",
+    "DeviceHangException",
+    "classify_device_error",
     "RetryExhaustedException",
+    "RETRY_TELEMETRY",
+    "RetryTelemetry",
     "RetryPolicy",
     "DEFAULT_IO_RETRY",
     "default_retry_policy",
@@ -77,6 +102,8 @@ __all__ = [
     "run_fingerprint",
     "FaultSchedule",
     "FaultInjectingFileSystem",
+    "FaultInjectingScanHook",
     "FlakyBatchSource",
     "InjectedIOError",
+    "InjectedDeviceError",
 ]

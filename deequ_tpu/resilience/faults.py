@@ -11,7 +11,11 @@ by tests/test_resilience.py).
 
 ``FaultInjectingFileSystem`` wraps any FileSystem and is registered via
 ``data.fs.register_filesystem`` (tests use the ``fault://`` scheme);
-``FlakyBatchSource`` wraps any BatchSource with per-batch-index faults.
+``FlakyBatchSource`` wraps any BatchSource with per-batch-index faults;
+``FaultInjectingScanHook`` injects DEVICE faults (OOM / compile / device
+loss / hangs) at the scan engine's execute seam
+(``ops.scan_engine.install_scan_fault_hook``), driving the device-fault
+tier-1 suite the same way the storage doubles drive the I/O suite.
 """
 
 from __future__ import annotations
@@ -19,7 +23,7 @@ from __future__ import annotations
 import hashlib
 import math
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 from deequ_tpu.data.fs import FileSystem
 from deequ_tpu.data.source import BatchSource
@@ -29,6 +33,15 @@ FaultKey = Tuple  # e.g. ("batch", 3) or ("open", "fault://dir/metrics.json")
 
 class InjectedIOError(IOError):
     """Marker subclass so tests can tell injected faults from real ones."""
+
+
+class InjectedDeviceError(RuntimeError):
+    """Stand-in for jaxlib's XlaRuntimeError (a RuntimeError whose message
+    carries the XLA status prefix): raised by FaultInjectingScanHook with
+    realistic RESOURCE_EXHAUSTED / INVALID_ARGUMENT / UNAVAILABLE
+    messages, so the exceptions.classify_device_error taxonomy is
+    exercised end-to-end — the engine sees exactly what a real device
+    fault looks like, not a pre-typed exception."""
 
 
 class FaultSchedule:
@@ -229,3 +242,108 @@ class FlakyBatchSource(BatchSource):
                 return
             yield batch
             idx += 1
+
+
+# -- device-fault injection --------------------------------------------------
+
+# realistic per-kind message templates (what jaxlib actually prints), so
+# classification runs on the same strings production sees
+_DEVICE_FAULT_MESSAGES = {
+    "oom": (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "{nbytes} bytes. [injected scan_id={scan_id} attempt={attempt}]"
+    ),
+    "compile": (
+        "INVALID_ARGUMENT: Compilation failure: injected lowering error "
+        "[scan_id={scan_id} attempt={attempt}]"
+    ),
+    "lost": (
+        "UNAVAILABLE: injected device halt; device is lost "
+        "[scan_id={scan_id} attempt={attempt}]"
+    ),
+}
+
+
+class FaultInjectingScanHook:
+    """Seeded, scripted DEVICE faults at the scan engine's execute seam.
+
+    Install with ``ops.scan_engine.install_scan_fault_hook(hook)`` (or the
+    ``scan_fault_injection`` context manager in tests). The engine calls
+    the hook immediately before each chunk dispatch with ``(boundary,
+    ctx)`` where ctx = {scan_id, attempt, chunk_index, fallback}:
+
+    - ``scan_id`` numbers logical ``run_scan`` calls process-wide and is
+      STABLE across bisection/fallback retries of the same scan — in a
+      streaming resilient run each batch is one scan, so scripting by
+      scan id is scripting by batch;
+    - ``attempt`` counts the engine's retries of that scan, so
+      ``faults={k: ("oom", 1)}`` means scan k OOMs once and succeeds on
+      the first bisected retry, while ``("lost", FaultSchedule.PERMANENT)``
+      is a dead accelerator only the CPU fallback can get past;
+    - ``fallback`` is True on the CPU-fallback attempt; by default the
+      hook spares it (``spare_fallback=True``) — the scripted fault models
+      a sick ACCELERATOR, not a sick host.
+
+    Fault kinds: ``"oom"`` / ``"compile"`` / ``"lost"`` raise an
+    ``InjectedDeviceError`` carrying the realistic XLA status message (the
+    taxonomy classifies it exactly like the real thing); ``"hang"`` sleeps
+    ``hang_seconds`` inside the watchdog-wrapped call, so an armed
+    ``device_deadline`` converts it into a ``DeviceHangException``.
+
+    Relative scripting: ``faults`` keys are scan ids; pass
+    ``relative=True`` to number scans from the first one THIS hook
+    observes (so tests don't depend on how many scans ran before).
+    Every injection appends ``(kind, scan_id, attempt)`` to ``injected``
+    and every observation to ``calls`` — determinism is asserted by
+    comparing these logs across replays.
+    """
+
+    def __init__(
+        self,
+        faults: Optional[Dict[int, Union[str, Tuple[str, float]]]] = None,
+        hang_seconds: float = 30.0,
+        spare_fallback: bool = True,
+        relative: bool = True,
+    ):
+        self.faults: Dict[int, Tuple[str, float]] = {}
+        for scan, spec in (faults or {}).items():
+            if isinstance(spec, str):
+                spec = (spec, 1)
+            kind, times = spec
+            if kind not in ("oom", "compile", "lost", "hang"):
+                raise ValueError(f"unknown device fault kind {kind!r}")
+            self.faults[int(scan)] = (kind, float(times))
+        self.hang_seconds = float(hang_seconds)
+        self.spare_fallback = bool(spare_fallback)
+        self.relative = bool(relative)
+        self._base_scan_id: Optional[int] = None
+        self.injected: List[Tuple[str, int, int]] = []
+        self.calls: List[Tuple[str, int, int, int]] = []
+
+    def __call__(self, boundary: str, ctx: Dict) -> None:
+        scan_id = int(ctx.get("scan_id", -1))
+        if self.relative:
+            if self._base_scan_id is None:
+                self._base_scan_id = scan_id
+            scan_id -= self._base_scan_id
+        attempt = int(ctx.get("attempt", 0))
+        self.calls.append(
+            (boundary, scan_id, attempt, int(ctx.get("chunk_index", -1)))
+        )
+        if ctx.get("fallback") and self.spare_fallback:
+            return
+        spec = self.faults.get(scan_id)
+        if spec is None:
+            return
+        kind, times = spec
+        if attempt >= times:
+            return
+        self.injected.append((kind, scan_id, attempt))
+        if kind == "hang":
+            time.sleep(self.hang_seconds)
+            return
+        raise InjectedDeviceError(
+            _DEVICE_FAULT_MESSAGES[kind].format(
+                nbytes=8 << 30, scan_id=scan_id, attempt=attempt
+            )
+        )

@@ -31,6 +31,77 @@ from deequ_tpu.exceptions import RetryExhaustedException
 DEFAULT_RETRY_ON = (OSError, TimeoutError)
 
 
+class RetryTelemetry:
+    """Process-wide retry accounting — retries were previously invisible
+    to callers (a run that quietly slept through 40 backoffs looked
+    identical to a clean one). Every RetryPolicy invocation records its
+    attempts, its total backoff sleep, and the last exception seen;
+    ``VerificationSuite`` snapshots the counters around each run and
+    surfaces the delta as ``VerificationResult.retry_stats``."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        # one consistent meaning across both producers (RetryPolicy.call
+        # and resilient_batches): invocations = retried-operation contexts
+        # entered; attempts = FAILED tries observed (a clean first try is
+        # not an "attempt" — millions of healthy batch reads must not
+        # swamp the counters); retries = failed tries that were followed
+        # by a backoff sleep; exhausted = operations abandoned past the
+        # attempt/deadline budget.
+        self.invocations = 0
+        self.attempts = 0
+        self.retries = 0
+        self.backoff_seconds = 0.0  # total time spent sleeping
+        self.exhausted = 0
+        self.last_exception: Optional[str] = None
+
+    def record_attempt(self) -> None:
+        self.attempts += 1
+
+    def record_retry(self, slept: float, exc: BaseException) -> None:
+        self.retries += 1
+        self.backoff_seconds += slept
+        self.last_exception = f"{type(exc).__name__}: {exc}"
+
+    def record_exhausted(self, exc: BaseException) -> None:
+        self.exhausted += 1
+        self.last_exception = f"{type(exc).__name__}: {exc}"
+
+    def snapshot(self) -> dict:
+        return {
+            "invocations": self.invocations,
+            "attempts": self.attempts,
+            "retries": self.retries,
+            "backoff_seconds": self.backoff_seconds,
+            "exhausted": self.exhausted,
+            "last_exception": self.last_exception,
+        }
+
+    def delta_since(self, before: dict) -> dict:
+        now = self.snapshot()
+        out = {
+            key: (
+                round(now[key] - before[key], 6)
+                if isinstance(now[key], float)
+                else now[key] - before[key]
+            )
+            for key in now
+            if key != "last_exception"
+        }
+        # the last exception is only meaningful if something failed since
+        out["last_exception"] = (
+            now["last_exception"]
+            if (out["retries"] or out["exhausted"])
+            else None
+        )
+        return out
+
+
+RETRY_TELEMETRY = RetryTelemetry()
+
+
 def _quarantinable(exc: BaseException) -> bool:
     """Errors that mean 'this batch is unreadable/undecodable' — eligible
     for quarantine under on_batch_error='skip'. I/O errors, typed
@@ -85,23 +156,29 @@ class RetryPolicy:
 
     def call(self, fn: Callable, *args, what: str = "operation", **kwargs):
         """Run ``fn`` under the policy; raises RetryExhaustedException when
-        the attempt budget or deadline runs out."""
+        the attempt budget or deadline runs out. Every invocation feeds
+        the process-wide RETRY_TELEMETRY counters."""
         start = time.monotonic()
         attempt = 0
+        RETRY_TELEMETRY.invocations += 1
         while True:
             try:
                 return fn(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — filtered below
                 if not self.is_retryable(e):
                     raise
+                RETRY_TELEMETRY.record_attempt()
                 attempt += 1
                 out_of_time = (
                     self.deadline is not None
                     and time.monotonic() - start >= self.deadline
                 )
                 if attempt >= self.max_attempts or out_of_time:
+                    RETRY_TELEMETRY.record_exhausted(e)
                     raise RetryExhaustedException(what, attempt, e) from e
-                time.sleep(self.delay_for(attempt - 1))
+                delay = self.delay_for(attempt - 1)
+                RETRY_TELEMETRY.record_retry(delay, e)
+                time.sleep(delay)
 
 
 # conservative default for storage-layer wrapping: quick, bounded, and a
@@ -237,6 +314,7 @@ def resilient_batches(
     cur = start
     attempts = 0
     consecutive_skips = 0
+    RETRY_TELEMETRY.invocations += 1
     t0 = time.monotonic()
     while True:
         it = make_iter(cur)
@@ -272,6 +350,9 @@ def resilient_batches(
             if not retryable and not skippable:
                 raise
             attempts += 1
+            # telemetry: a FAILED read is an attempt (same meaning as
+            # RetryPolicy.call — the clean fast path never counts)
+            RETRY_TELEMETRY.record_attempt()
             # non-retryable-but-skippable errors quarantine IMMEDIATELY:
             # the policy's retry_on filter said backoff cannot help here
             out_of_budget = (
@@ -288,6 +369,7 @@ def resilient_batches(
                 if on_batch_error == "skip":
                     consecutive_skips += 1
                     if consecutive_skips > max_consecutive_skips:
+                        RETRY_TELEMETRY.record_exhausted(e)
                         raise RetryExhaustedException(
                             f"{consecutive_skips} consecutive batches "
                             f"unreadable (through batch {cur}) — the source "
@@ -301,10 +383,13 @@ def resilient_batches(
                     attempts = 0
                     t0 = time.monotonic()
                     continue
+                RETRY_TELEMETRY.record_exhausted(e)
                 raise RetryExhaustedException(
                     f"batch {cur} read", attempts, e
                 ) from e
-            time.sleep(policy.delay_for(attempts - 1))
+            delay = policy.delay_for(attempts - 1)
+            RETRY_TELEMETRY.record_retry(delay, e)
+            time.sleep(delay)
 
 
 class RetryingBatchSource:

@@ -29,12 +29,26 @@ class VerificationResult:
 
     ``skipped_batches`` lists the stream batch indices quarantined under
     ``on_batch_error="skip"`` — the run's metrics exclude those rows, and
-    the omission is REPORTED here rather than silently dropped."""
+    the omission is REPORTED here rather than silently dropped.
+
+    Degradation is reported the same way:
+
+    - ``device_events`` — the degradation decisions this run's scans made
+      (OOM chunk bisections, watchdog timeouts, CPU fallbacks; the
+      structured rows ``ScanStats.record_degradation`` logs);
+    - ``fallback_backend`` — set (e.g. ``"cpu"``) when any scan of this
+      run completed on the fallback backend instead of the accelerator;
+    - ``retry_stats`` — aggregate RetryPolicy telemetry for the run
+      (invocations, attempts, retries, total backoff sleep, exhaustions,
+      last exception) — retries are no longer invisible to callers."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
     metrics: Dict[Analyzer, Metric]
     skipped_batches: List[int] = field(default_factory=list)
+    device_events: List[dict] = field(default_factory=list)
+    fallback_backend: Optional[str] = None
+    retry_stats: Dict[str, object] = field(default_factory=dict)
 
     @staticmethod
     def success_metrics_as_rows(
@@ -129,16 +143,34 @@ class VerificationSuite:
         checkpoint=None,
         on_batch_error: str = "fail",
         retry_policy=None,
+        on_device_error: str = "fail",
+        device_deadline: Optional[float] = None,
     ) -> VerificationResult:
         """Resilience knobs (streaming tables; deequ_tpu/resilience):
         ``checkpoint`` (StreamCheckpointer or directory path) makes the
         run resumable after a crash; ``on_batch_error="skip"`` quarantines
         unreadable batches (reported on the result) instead of failing the
-        run; ``retry_policy`` overrides the batch-read RetryPolicy."""
+        run; ``retry_policy`` overrides the batch-read RetryPolicy.
+
+        Device-fault knobs (any table; ops/device_policy.py):
+        ``on_device_error="fallback"`` re-runs scans the accelerator
+        cannot complete (compile failure, device loss, hang, OOM below
+        the bisection floor) on the CPU backend; ``device_deadline``
+        (seconds) arms the compute watchdog that converts a hung device
+        call into a typed ``DeviceHangException``. Degradations taken are
+        reported on ``result.device_events`` / ``result.fallback_backend``
+        and retry telemetry on ``result.retry_stats``."""
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.resilience.retry import RETRY_TELEMETRY
+
         analyzers = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
         unique_analyzers = _dedup_analyzers(analyzers)
+
+        retry_before = RETRY_TELEMETRY.snapshot()
+        events_before = len(SCAN_STATS.degradation_events)
+        fallback_before = SCAN_STATS.fallback_scans
 
         analysis_context = AnalysisRunner.do_analysis_run(
             data,
@@ -152,6 +184,8 @@ class VerificationSuite:
             checkpoint=checkpoint,
             on_batch_error=on_batch_error,
             retry_policy=retry_policy,
+            on_device_error=on_device_error,
+            device_deadline=device_deadline,
         )
 
         # evaluate BEFORE appending the new result: anomaly constraints query
@@ -159,6 +193,14 @@ class VerificationSuite:
         # (reference VerificationSuite.scala evaluates at L263-281, then saves
         # at L174-193)
         result = VerificationSuite._evaluate(checks, analysis_context)
+        # degradation + retry telemetry taken DURING this run (deltas
+        # against the process-wide counters)
+        result.device_events = [
+            dict(e) for e in SCAN_STATS.degradation_events[events_before:]
+        ]
+        if SCAN_STATS.fallback_scans > fallback_before:
+            result.fallback_backend = SCAN_STATS.fallback_backend
+        result.retry_stats = RETRY_TELEMETRY.delta_since(retry_before)
 
         if metrics_repository is not None and save_or_append_results_with_key is not None:
             _save_or_append(
@@ -358,6 +400,8 @@ class VerificationRunBuilder:
         self._checkpoint = None
         self._on_batch_error = "fail"
         self._retry_policy = None
+        self._on_device_error = "fail"
+        self._device_deadline: Optional[float] = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -432,6 +476,33 @@ class VerificationRunBuilder:
         self._retry_policy = policy
         return self
 
+    def on_device_error(self, policy: str) -> "VerificationRunBuilder":
+        """Device-fault policy for this run's fused scans, mirroring
+        ``on_batch_error``: ``"fail"`` (default — a scan the accelerator
+        cannot complete fails its analyzers with a TYPED
+        ``Device*Exception`` failure metric) or ``"fallback"`` (the scan
+        re-runs on the CPU backend; states are backend-agnostic monoids,
+        so metrics match the accelerator's). Device OOMs bisect the chunk
+        size under either policy. Degradations land on
+        ``VerificationResult.device_events``."""
+        if policy not in ("fail", "fallback"):
+            raise ValueError(
+                f"on_device_error must be 'fail' or 'fallback', "
+                f"got {policy!r}"
+            )
+        self._on_device_error = policy
+        return self
+
+    def with_device_deadline(self, seconds: float) -> "VerificationRunBuilder":
+        """Arm the compute watchdog: any blocking device call of this run
+        (dispatch, drain) exceeding ``seconds`` raises a typed
+        ``DeviceHangException`` — which ``on_device_error="fallback"``
+        then converts into a CPU re-run — instead of hanging the run
+        forever. Also settable process-wide via the
+        ``DEEQU_TPU_DEVICE_DEADLINE`` env var."""
+        self._device_deadline = float(seconds)
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -467,6 +538,8 @@ class VerificationRunBuilder:
             checkpoint=self._checkpoint,
             on_batch_error=self._on_batch_error,
             retry_policy=self._retry_policy,
+            on_device_error=self._on_device_error,
+            device_deadline=self._device_deadline,
         )
 
 
