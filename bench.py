@@ -203,11 +203,17 @@ def main():
     # standard way to see through scheduler noise
     reps = 1 if smoke else 3
     wall = float("inf")
+    snap = None
     for _ in range(reps):
         SCAN_STATS.reset()
         t0 = time.time()
         ctx = AnalysisRunner.do_analysis_run(table, analyzers)
-        wall = min(wall, time.time() - t0)
+        rep_wall = time.time() - t0
+        if rep_wall < wall:
+            # keep the breakdown of the SAME rep the headline wall comes
+            # from, so drain-wait fractions are internally consistent
+            wall = rep_wall
+            snap = SCAN_STATS.snapshot()
 
     # measured fetch-latency floor: ONE trivial dispatch+fetch round trip —
     # the hard lower bound any single scan pays on this tunnel
@@ -228,9 +234,16 @@ def main():
 
     n_failed = sum(1 for m in ctx.all_metrics() if m.value.is_failure)
     assert n_failed == 0, f"{n_failed} metrics failed"
-    assert SCAN_STATS.scan_passes == 1, "fusion regression: expected 1 pass"
-    assert SCAN_STATS.resident_passes == 1, "resident-path regression"
-    assert SCAN_STATS.bytes_packed == 0, "unexpected host re-transfer"
+    assert snap["scan_passes"] == 1, "fusion regression: expected 1 pass"
+    assert snap["resident_passes"] == 1, "resident-path regression"
+    assert snap["bytes_packed"] == 0, "unexpected host re-transfer"
+    # the one-fetch-per-scan contract: every op of this workload is
+    # device-foldable, so the whole fused pass materializes exactly one
+    # device->host result regardless of chunk count
+    assert snap["device_fetches"] == 1, (
+        "one-fetch contract regression: "
+        f"{snap['device_fetches']} fetches for 1 scan pass"
+    )
 
     rows_per_sec = n_rows / wall
     # floor-normalized telemetry (VERDICT r5 #6): the tunnel's fetch floor
@@ -238,16 +251,25 @@ def main():
     # can actually compare
     fetch_floor_ms = round(floor * 1000, 2)
     compute_above_floor_ms = round(max(wall - floor, 0.0) * 1000, 2)
-    # execution breakdown to stderr (the driver parses stdout's single line)
-    snap = SCAN_STATS.snapshot()
     # total tunnel traffic both ways: host->device packing (0 on the
     # resident path, asserted above) + device->host result fetches
     bytes_shipped = int(snap["bytes_packed"]) + int(snap["bytes_fetched"])
+    # fetch-floor amortization record: fetches per fused pass (the
+    # one-fetch contract) and the fraction of wall spent blocked on the
+    # device — the term BENCH_r05 measured at ~98%
+    device_fetches_per_scan = round(
+        snap["device_fetches"] / max(snap["scan_passes"], 1), 3
+    )
+    drain_wait_frac = round(
+        min(snap["drain_wait_seconds"] / max(wall, 1e-9), 1.0), 4
+    )
+    # execution breakdown to stderr (the driver parses stdout's single line)
     print(
         f"breakdown: wall={wall:.3f}s dispatch={snap['dispatch_seconds']:.3f}s "
         f"drain_wait={snap['drain_wait_seconds']:.3f}s "
+        f"device_fetches={snap['device_fetches']} "
         f"bytes_resident={snap['bytes_resident']/1e9:.2f}GB "
-        f"effective={SCAN_STATS.effective_bytes_per_sec()/1e9:.1f}GB/s "
+        f"effective={(snap['bytes_packed'] + snap['bytes_resident']) / max(snap['scan_seconds'], 1e-9)/1e9:.1f}GB/s "
         f"(v5e HBM peak ~819GB/s)",
         file=sys.stderr,
     )
@@ -269,6 +291,8 @@ def main():
                     "fetch_floor_ms": fetch_floor_ms,
                     "compute_above_floor_ms": compute_above_floor_ms,
                     "bytes_shipped": bytes_shipped,
+                    "device_fetches_per_scan": device_fetches_per_scan,
+                    "drain_wait_frac": drain_wait_frac,
                     **ckpt_probe,
                 }
             )
@@ -289,6 +313,8 @@ def main():
                 "fetch_floor_ms": fetch_floor_ms,
                 "compute_above_floor_ms": compute_above_floor_ms,
                 "bytes_shipped": bytes_shipped,
+                "device_fetches_per_scan": device_fetches_per_scan,
+                "drain_wait_frac": drain_wait_frac,
                 **ckpt_probe,
             }
         )

@@ -789,6 +789,63 @@ class AnalysisRunner:
                         failed_groups[key_to_group[key]] = exc
         read_cols = sorted(columns) if columns is not None else None
 
+        # deferred per-batch fused scans: each batch's scan dispatches
+        # immediately (and, with device-foldable ops, folds its chunk
+        # partials ON device), but the device->host fetch is batched —
+        # ONE fetch_deferred round trip at each checkpoint boundary (or
+        # every `drain_every` batches without one) instead of a fetch
+        # per batch. Fold order stays strictly batch order, so the fold
+        # stacks — and therefore checkpointed/resumed metrics — are
+        # bit-identical to the eager per-batch loop.
+        drain_every = (
+            checkpoint.every_batches if checkpoint is not None else 8
+        )
+        pending: List[Tuple] = []  # (scannable, plan, DeferredScan)
+
+        def drain_pending() -> None:
+            if not pending:
+                return
+            from deequ_tpu.ops.scan_engine import fetch_deferred
+
+            entries = list(pending)
+            pending.clear()
+            # one coalesced fetch; per-scan failures isolate (a failed
+            # batch fails ITS analyzers at result(), siblings fold on).
+            # A fault of the FETCH itself (typed device error surfacing
+            # at the round trip) is scoped to the pending batches' scans
+            # — own-pass/grouping folds and later batches keep going,
+            # matching the shared-scan failure rule's blast radius.
+            try:
+                fetch_deferred([scan for (_, _, scan) in entries])
+            except Exception as e:  # noqa: BLE001
+                wrapped = wrap_if_necessary(e)
+                for scannable, _, _ in entries:
+                    for a in scannable:
+                        if a not in failed:
+                            failed[a] = a.to_failure_metric(wrapped)
+                return
+            for scannable, plan, scan in entries:
+                try:
+                    results = scan.result()
+                except Exception as e:  # noqa: BLE001
+                    wrapped = wrap_if_necessary(e)
+                    for a in scannable:
+                        if a not in failed:
+                            failed[a] = a.to_failure_metric(wrapped)
+                    continue
+                for a, (exec_idx, extract) in zip(scannable, plan):
+                    if a in failed:
+                        continue
+                    try:
+                        r = results[exec_idx]
+                        if extract is not None:
+                            r = extract(r)
+                        folders[keys[a]].add(a.state_from_scan_result(r))
+                    except Exception as e:  # noqa: BLE001
+                        failed[a] = a.to_failure_metric(
+                            wrap_if_necessary(e)
+                        )
+
         def fold_batch(batch) -> None:
             alive_scan = [a for a in scanning if a not in failed]
             if alive_scan:
@@ -798,23 +855,14 @@ class AnalysisRunner:
                 # batches via each op's analyzer cache_key (scan_engine)
                 sctx, scannable, plan, results = (
                     AnalysisRunner._dispatch_scanning_analyzers(
-                        batch, alive_scan,
+                        batch, alive_scan, defer=True,
                         on_device_error=on_device_error,
                         device_deadline=device_deadline,
                     )
                 )
                 failed.update(sctx.metric_map)
                 if results is not None:
-                    for a, (exec_idx, extract) in zip(scannable, plan):
-                        try:
-                            r = results[exec_idx]
-                            if extract is not None:
-                                r = extract(r)
-                            folders[keys[a]].add(a.state_from_scan_result(r))
-                        except Exception as e:  # noqa: BLE001
-                            failed[a] = a.to_failure_metric(
-                                wrap_if_necessary(e)
-                            )
+                    pending.append((scannable, plan, results))
             for a in own_pass:
                 if a in failed:
                     continue
@@ -849,10 +897,10 @@ class AnalysisRunner:
                 got_any = True
                 fold_batch(batch)
                 n_done = idx + 1
-                if (
-                    checkpoint is not None
-                    and n_done % checkpoint.every_batches == 0
-                ):
+                ckpt_due = checkpoint is not None and checkpoint.due(n_done)
+                if ckpt_due or len(pending) >= drain_every:
+                    drain_pending()
+                if ckpt_due:
                     failed_msgs = {
                         keys[a]: str(getattr(m.value, "exception", m.value))
                         for a, m in failed.items()
@@ -881,6 +929,7 @@ class AnalysisRunner:
                     else Schema([data.schema[c] for c in read_cols])
                 )
                 fold_batch(_empty_table(schema))
+            drain_pending()  # tail batches since the last boundary
         except Exception as e:  # noqa: BLE001 — a read failure past
             # retries fails every analyzer of the pass (shared-scan rule);
             # checkpoints written so far remain for the resume, but temp

@@ -110,16 +110,19 @@ class GroupBudgetIgnoredWarning(UserWarning):
 # where they surfaced, into this typed family — the degradation policies
 # (bisection/fallback/watchdog) and user code both dispatch on types.
 
-#: the three device boundaries where classification happens
-DEVICE_BOUNDARIES = ("transfer", "trace", "execute")
+#: the device boundaries where classification happens
+DEVICE_BOUNDARIES = ("transfer", "trace", "execute", "fetch")
 
 
 class DeviceException(MetricCalculationRuntimeException):
     """A classified device-layer (XLA/jaxlib) failure.
 
     ``boundary`` names where it surfaced: ``"transfer"`` (device_put /
-    chunk pack), ``"trace"`` (jit trace / compile), or ``"execute"``
-    (dispatch / block_until_ready / result fetch)."""
+    chunk pack), ``"trace"`` (jit trace / compile), ``"execute"``
+    (dispatch / block_until_ready), or ``"fetch"`` (the device->host
+    result materialization — with the on-device partial fold this is
+    where ASYNC execute failures surface, since it is the scan's one
+    blocking round trip)."""
 
     def __init__(self, message: str, boundary: str = "execute"):
         super().__init__(message)

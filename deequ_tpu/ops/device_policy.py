@@ -7,11 +7,14 @@ that trade that recovery model for speed get nothing (Flare,
 arXiv:1703.08219). This module is the engine-side half of ours:
 
 - :func:`device_call` wraps every blocking device call at one of the
-  three boundaries (``transfer`` / ``trace`` / ``execute``), converting
-  raw jaxlib errors into the typed taxonomy (``exceptions.py``) and —
-  when a wall-clock ``deadline`` is set — running the call on a watchdog
-  worker thread so a HUNG device becomes a typed
-  ``DeviceHangException`` instead of a frozen run;
+  four boundaries (``transfer`` / ``trace`` / ``execute`` / ``fetch``),
+  converting raw jaxlib errors into the typed taxonomy
+  (``exceptions.py``) and — when a wall-clock ``deadline`` is set —
+  running the call on a watchdog worker thread so a HUNG device becomes
+  a typed ``DeviceHangException`` instead of a frozen run. With the
+  on-device partial fold the ``fetch`` boundary (the scan's ONE
+  device->host round trip) is where async execute faults surface, so
+  the watchdog and the fault classification both stay armed there;
 - :func:`install_scan_fault_hook` is the deterministic injection seam the
   resilience tests drive (``resilience/faults.py:FaultInjectingScanHook``);
 - :class:`DeviceHealth` counts classified faults so a backend that

@@ -163,6 +163,27 @@ def _sum_product_pair(p, e, xp):
     return xp.sum(p.astype(xp.float64)) + xp.sum(e).astype(xp.float64)
 
 
+def merge_tags_f64(is_sum, is_min, acc, new, xp):
+    """Elementwise tagged merge of two flat f64 STATE vectors (the device
+    analogue of ``scan_engine._tag_reduce_np``): ``is_sum``/``is_min``
+    boolean masks select add / minimum, everything else is maximum.
+
+    Deliberately UNcompensated: state leaves are already f64 chunk
+    aggregates (the per-chunk reductions above did the two-float work),
+    and the host fold merges them with plain IEEE f64 add/min/max — a
+    TwoSum-compensated device merge would be *more* accurate than the
+    host fold and break the bit-identity contract between the two paths
+    (docs/numerics.md, fold order & determinism). f64 adds on the tiny
+    state vector are scalar-count work; the 10x software-f64 penalty
+    that pushed O(n) compute onto the f32 pair does not apply. Min/max
+    propagate NaN exactly as numpy's do."""
+    return xp.where(
+        is_sum,
+        acc + new,
+        xp.where(is_min, xp.minimum(acc, new), xp.maximum(acc, new)),
+    )
+
+
 def masked_sum(hi, lo, ok, xp):
     """Sum of the pair values where ok — f64 scalar, ~1e-13 accurate."""
     if lo is None:

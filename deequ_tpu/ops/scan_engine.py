@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import weakref
 from dataclasses import dataclass, field
 from functools import partial
@@ -65,6 +66,74 @@ MAX_CHUNK_ROWS = 1 << 23
 # (decoded batch in the prefetch queue, packed buffers, in-flight transfers),
 # so the host-RSS bound is ~6x the chunk size
 STREAM_CHUNK_BYTES = 128 << 20
+
+# pipelined-dispatch window default: how many chunks stay in flight before
+# the engine blocks on the oldest (bounds pinned host buffers / queued
+# device work). Override per call (run_scan(window=...)) or process-wide
+# via DEEQU_TPU_SCAN_WINDOW.
+DEFAULT_SCAN_WINDOW = 3
+
+# device-fold gather capacity for STREAMS (chunk count unknown up front):
+# the on-device accumulator reserves this many chunk slots for 'gather'
+# leaves; past it the accumulator drains to the host (one fetch) and a
+# fresh one continues — fetches stay O(chunks / capacity), and the f64
+# 'sum' regrouping that restart introduces is ulp-level (docs/numerics.md)
+STREAM_FOLD_CAPACITY = 512
+
+# in-memory scans with 'gather' leaves size the accumulator to the exact
+# chunk count; past this many chunks they keep the host fold instead —
+# the capacity scales the gather region, and OOM bisection (which DOUBLES
+# n_chunks per halving) must not grow the accumulator on an already-OOM
+# device (each capacity is also a fresh merge-program trace)
+MAX_FOLD_CAPACITY = 1024
+
+
+def _resolve_scan_window(window: Optional[int] = None) -> int:
+    """The pipelined-dispatch window: explicit argument wins, then the
+    DEEQU_TPU_SCAN_WINDOW env var, then DEFAULT_SCAN_WINDOW. Validated
+    >= 1 (a zero/negative window would deadlock the dispatch loop)."""
+    if window is None:
+        raw = os.environ.get("DEEQU_TPU_SCAN_WINDOW", "").strip()
+        if raw:
+            try:
+                window = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"DEEQU_TPU_SCAN_WINDOW must be an integer >= 1, "
+                    f"got {raw!r}"
+                ) from None
+        else:
+            window = DEFAULT_SCAN_WINDOW
+    window = int(window)
+    if window < 1:
+        raise ValueError(f"scan window must be >= 1, got {window}")
+    return window
+
+
+def _device_fold_enabled() -> bool:
+    """Escape hatch: DEEQU_TPU_DEVICE_FOLD=0 reverts to the host-side
+    per-chunk partial fold (one device->host fetch PER CHUNK instead of
+    per scan) — for A/B numerics comparison and emergencies."""
+    return os.environ.get("DEEQU_TPU_DEVICE_FOLD", "1") != "0"
+
+
+def _fused_resident_enabled() -> bool:
+    """The fused resident loop compiles the chunk step INSIDE a lax.scan;
+    XLA's optimizer may fuse/contract the compensated f32 reductions
+    differently there than in the standalone per-chunk program, shifting
+    f64 sum leaves by ~1 ulp vs the host fold (deterministic per
+    program; documented in docs/numerics.md). DEEQU_TPU_FUSED_RESIDENT=0
+    keeps the per-chunk device fold (bit-identical to the host fold,
+    still one fetch) while dropping only the single-dispatch fusion."""
+    return os.environ.get("DEEQU_TPU_FUSED_RESIDENT", "1") != "0"
+
+
+def device_foldable(op: "ScanOp") -> bool:
+    """True when ``op``'s chunk partials can fold ON DEVICE: sum/min/max
+    leaves merge elementwise and 'gather' leaves append into a
+    fixed-capacity device buffer. Ops with a ``compact()`` hook (KLL)
+    need host-side compaction mid-fold and keep the host path."""
+    return op.compact is None
 
 
 def _auto_chunk_rows_from_dtypes(
@@ -158,6 +227,10 @@ class ScanStats:
         # device->host result bytes (grouping paths): the sparse group-by
         # contract is fetched bytes ~ O(k*G), never O(k*n)
         self.bytes_fetched = 0
+        # device->host MATERIALIZATIONS (every np.asarray of a device
+        # array): the observable for the one-fetch-per-scan contract — a
+        # multi-chunk device-folded scan must show exactly 1
+        self.device_fetches = 0
         # time spent issuing step dispatches (host-side enqueue; near zero
         # unless the runtime backpressures) vs time blocked waiting for
         # device results in drain. drain_wait ~= device compute + any
@@ -194,6 +267,12 @@ class ScanStats:
         # is a point-in-time record, not a live view
         snap["degradation_events"] = [dict(e) for e in self.degradation_events]
         return snap
+
+    def record_fetch(self, nbytes: int) -> None:
+        """Account one device->host materialization (the unit the
+        one-fetch-per-scan contract counts) and its result bytes."""
+        self.device_fetches += 1
+        self.bytes_fetched += int(nbytes)
 
     def record_degradation(self, kind: str, **detail) -> dict:
         """Append one degradation decision (kind: 'oom_bisect' |
@@ -595,11 +674,30 @@ class DeviceTableCache:
         self.mesh = mesh
         self.nbytes = nbytes
         self.device_count = device_count
+        # lazily-built (n_chunks, ...) stacked views for the fused
+        # single-dispatch lax.scan loop — a second HBM copy, so gated on
+        # the resident budget and dropped with the residency on eviction
+        self._stacked = None
         # (op cache_keys, chunk) -> (step_fn, shapes): reused traced
         # programs, LRU-bounded so long-lived services with varied analyzer
         # sets don't accumulate executables without limit
         self.programs = _BoundedLRU(self.MAX_CACHED_PROGRAMS)
         _ACTIVE_CACHES.add(self)
+
+    def stacked_chunks(self):
+        """The resident chunks stacked along a leading chunk axis (for the
+        one-dispatch fused loop), or None when a second copy of the table
+        would blow the combined HBM budget. Built once per cache."""
+        if len(self.device_chunks) < 2:
+            return None
+        if self._stacked is None:
+            if total_resident_bytes() + self.nbytes > self.MAX_RESIDENT_BYTES:
+                return None
+            self._stacked = tuple(
+                jnp.stack([c[j] for c in self.device_chunks])
+                for j in range(7)
+            )
+        return self._stacked
 
     def get_program(self, key):
         return self.programs.get(key)
@@ -639,7 +737,12 @@ _GLOBAL_PROGRAMS = _BoundedLRU(64)
 
 
 def total_resident_bytes() -> int:
-    return sum(c.nbytes for c in _ACTIVE_CACHES)
+    # a built stacked fused-loop copy doubles that cache's true HBM
+    # footprint — count it, or the budget gate overcommits the device
+    return sum(
+        c.nbytes * (2 if c._stacked is not None else 1)
+        for c in _ACTIVE_CACHES
+    )
 
 
 def persist_table(
@@ -726,7 +829,9 @@ def _split_lut_key(key: str) -> Tuple[str, str]:
 
 
 def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()):
-    """Build (jitted flat step fn, shape fn) for one packer layout.
+    """Build (jitted flat step fn, shape fn, raw flat fn) for one packer
+    layout — the raw (unjitted) flat fn is what the fused resident
+    ``lax.scan`` loop composes into its single dispatch.
 
     The flat step computes every op's partial state for one packed chunk,
     merges across the mesh with per-leaf collectives, and concatenates all
@@ -787,14 +892,14 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
                 inner(values, hi, lo, narrow_i, masks, codes, row_valid, luts)
             )
 
-        return jax.jit(flat_outer), inner
+        return jax.jit(flat_outer), inner, flat_outer
 
     def flat_single(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
         return _flatten(
             step(values, hi, lo, narrow_i, masks, codes, row_valid, luts)
         )
 
-    return jax.jit(flat_single), step
+    return jax.jit(flat_single), step, flat_single
 
 
 def _unflatten_partials(flat: np.ndarray, shapes):
@@ -883,31 +988,245 @@ def _global_prog_key(prog_key, packer, mesh):
     return (prog_key, layout, _mesh_key(mesh))
 
 
+class _DeviceFoldPlan:
+    """The on-device analogue of ``_tag_reduce_np``: folds per-chunk flat
+    state vectors into a single device-resident accumulator so a whole
+    scan pays ONE device->host fetch (of the tiny final vector) instead
+    of one per chunk.
+
+    Accumulator layout (one flat f64 vector)::
+
+        [ elementwise region | gather region | chunk counter (1) ]
+
+    - sum/min/max leaves live in the elementwise region and merge with
+      plain f64 ops — the exact operations the host fold applies, in the
+      same left-to-right chunk order, so results are bit-identical
+      (``deequ_tpu.ops.df32.merge_tags_f64`` documents why the merge must
+      NOT be compensated);
+    - 'gather' leaves (Welford moments, co-moments) append into a
+      fixed-capacity block of ``capacity`` chunk slots via
+      ``dynamic_update_slice`` at the on-device chunk counter — the
+      device-side equivalent of the host's np.concatenate, order
+      preserved;
+    - the counter rides in the accumulator itself so the merge needs no
+      per-chunk host scalar (each host->device transfer costs a round
+      trip on slow links).
+
+    Integer leaves accumulate in f64 (exact below 2^53 — far past the
+    2^31 wrap ``_unflatten_partials`` widens against) and widen to i64 at
+    the final host unflatten, matching the host fold's dtypes.
+    """
+
+    def __init__(self, ops, shapes, capacity: int, donate: bool):
+        self.capacity = int(capacity)
+        elem_off = 0
+        gather_off = 0
+        src_off = 0
+        elem_src: List[np.ndarray] = []
+        sum_mask: List[np.ndarray] = []
+        min_mask: List[np.ndarray] = []
+        elem_init: List[np.ndarray] = []
+        self._gather_specs: List[Tuple[int, int, int]] = []
+        # per op: (treedef, [(tag, region_off, size, shape, dtype), ...])
+        self._op_plans = []
+        contiguous = True
+        for op, shp in zip(ops, shapes):
+            tag_leaves = jax.tree.leaves(op.tags)
+            shape_leaves = jax.tree.leaves(shp)
+            if len(tag_leaves) != len(shape_leaves):
+                raise ValueError(
+                    f"op {op.cache_key!r}: tags/partials structure mismatch"
+                )
+            leaf_plans = []
+            for tag, sd in zip(tag_leaves, shape_leaves):
+                size = int(np.prod(sd.shape)) if sd.shape else 1
+                if tag == "gather":
+                    contiguous = False
+                    self._gather_specs.append((src_off, size, gather_off))
+                    leaf_plans.append(
+                        (tag, gather_off, size, sd.shape, sd.dtype)
+                    )
+                    gather_off += self.capacity * size
+                else:
+                    elem_src.append(np.arange(src_off, src_off + size))
+                    is_sum = tag == "sum"
+                    is_min = tag == "min"
+                    if not (is_sum or is_min or tag == "max"):
+                        raise ValueError(f"unknown reduce tag {tag}")
+                    sum_mask.append(np.full(size, is_sum))
+                    min_mask.append(np.full(size, is_min))
+                    elem_init.append(
+                        np.full(
+                            size,
+                            0.0 if is_sum else (np.inf if is_min else -np.inf),
+                        )
+                    )
+                    leaf_plans.append((tag, elem_off, size, sd.shape, sd.dtype))
+                    elem_off += size
+                src_off += size
+            self._op_plans.append((jax.tree.structure(shp), leaf_plans))
+        self.elem_size = elem_off
+        self.gather_size = gather_off
+        self.acc_size = self.elem_size + self.gather_size + 1
+        cat = lambda parts, dt: (  # noqa: E731
+            np.concatenate(parts).astype(dt)
+            if parts
+            else np.zeros(0, dtype=dt)
+        )
+        # when no gather leaves exist the elementwise region IS the chunk
+        # flat (same order, same offsets): skip the take() entirely
+        self._elem_src = None if contiguous else cat(elem_src, np.int32)
+        self._sum_mask = cat(sum_mask, bool)
+        self._min_mask = cat(min_mask, bool)
+        self._init_np = np.concatenate(
+            [cat(elem_init, np.float64), np.zeros(self.gather_size + 1)]
+        )
+        donate_args = (0,) if donate else ()
+        self._merge_jit = jax.jit(self.merge_body, donate_argnums=donate_args)
+
+    def fresh_init(self):
+        """A NEW device accumulator (never reuse one across scans: the
+        first merge donates it)."""
+        return jnp.asarray(self._init_np)
+
+    def merge_body(self, acc, new):
+        """Pure traced merge: fold one chunk's flat vector into the
+        accumulator (left-to-right order = call order)."""
+        if self.elem_size:
+            from deequ_tpu.ops.df32 import merge_tags_f64
+
+            elem = acc[: self.elem_size]
+            new_elem = new if self._elem_src is None else new[self._elem_src]
+            merged = merge_tags_f64(
+                self._sum_mask, self._min_mask, elem, new_elem, jnp
+            )
+            acc = jax.lax.dynamic_update_slice(acc, merged, (0,))
+        if self._gather_specs:
+            ci = acc[self.acc_size - 1].astype(jnp.int32)
+            for src, size, base in self._gather_specs:
+                chunk_leaf = jax.lax.dynamic_slice(new, (src,), (size,))
+                acc = jax.lax.dynamic_update_slice(
+                    acc, chunk_leaf, (self.elem_size + base + ci * size,)
+                )
+        return jax.lax.dynamic_update_slice(
+            acc,
+            acc[self.acc_size - 1 :] + 1.0,
+            (self.acc_size - 1,),
+        )
+
+    def merge(self, acc, new):
+        return self._merge_jit(acc, new)
+
+    def unflatten_host(self, flat: np.ndarray, filled: int) -> List[Any]:
+        """The fetched accumulator back into per-op reduced pytrees —
+        shaped exactly like the host fold's output (`filled` = chunks
+        actually merged; gather blocks truncate to it)."""
+        out = []
+        for treedef, leaf_plans in self._op_plans:
+            leaves = []
+            for tag, off, size, shape, dtype in leaf_plans:
+                wide = (
+                    np.int64 if np.issubdtype(dtype, np.integer) else dtype
+                )
+                if tag == "gather":
+                    base = self.elem_size + off
+                    block = flat[base : base + filled * size]
+                    lead = shape[0] if shape else 1
+                    leaf = block.reshape((filled * lead,) + tuple(shape[1:]))
+                else:
+                    leaf = flat[off : off + size].reshape(shape)
+                    if not shape:
+                        leaf = leaf.reshape(())
+                leaves.append(leaf.astype(wide))
+            out.append(jax.tree.unflatten(treedef, leaves))
+        return out
+
+
+# memoized fold plans (each carries one jitted merge program): keyed on
+# the leaf-level identity so repeated scans of the same analyzer suite
+# reuse one compiled merge instead of retracing per run
+_FOLD_PLANS = _BoundedLRU(64)
+
+
+def _fold_plan_for(ops, shapes, capacity: int) -> _DeviceFoldPlan:
+    # donation makes the merge update the accumulator in place; the CPU
+    # backend doesn't implement donation and would warn per compile
+    donate = jax.default_backend() != "cpu"
+    try:
+        key = (
+            capacity,
+            donate,
+            tuple(
+                (
+                    jax.tree.structure(shp),
+                    tuple(
+                        (tag, tuple(sd.shape), str(sd.dtype))
+                        for tag, sd in zip(
+                            jax.tree.leaves(op.tags), jax.tree.leaves(shp)
+                        )
+                    ),
+                )
+                for op, shp in zip(ops, shapes)
+            ),
+        )
+        hash(key)
+    except TypeError:
+        key = None
+    if key is not None:
+        plan = _FOLD_PLANS.get(key)
+        if plan is not None:
+            return plan
+    plan = _DeviceFoldPlan(ops, shapes, capacity, donate)
+    if key is not None:
+        _FOLD_PLANS.put(key, plan)
+    return plan
+
+
 class _PartialFolder:
-    """Accumulates per-chunk flat results into per-op reduced pytrees."""
+    """Accumulates per-chunk flat results into per-op reduced pytrees.
+
+    Two modes: the host fold (one ``drain`` per chunk result, tag-reduced
+    with numpy) and the device fold (``fold_plan`` set: each drained
+    vector is a device-side accumulator covering ``fold_filled`` chunks,
+    unflattened by the plan and merged — a scan that stays within one
+    accumulator drains exactly once)."""
 
     def __init__(self, ops):
         self.ops = ops
         self.merged = None
         self.shapes = None
+        self.fold_plan: Optional[_DeviceFoldPlan] = None
+        self.fold_filled = 0
 
     def drain(self, device_result) -> None:
         import time as _time
 
+        # host-side slices (fetch_deferred hands those out) are already
+        # materialized: only a true device array counts as a fetch
+        fetched = not isinstance(device_result, np.ndarray)
         t0 = _time.time()
         try:
             flat = np.asarray(device_result)
         except Exception as e:  # noqa: BLE001 — async device failures
             # (OOM, device loss) surface HERE, at the fetch: classify once
             # so every drain path (inline, deferred, grouped) raises typed
-            typed = classify_device_error(e, "execute")
+            typed = classify_device_error(e, "fetch")
             if typed is not None:
                 raise typed from e
             raise
         finally:
             SCAN_STATS.drain_wait_seconds += _time.time() - t0
-        partials = _unflatten_partials(flat, self.shapes)
-        SCAN_STATS.chunks_processed += 1
+        if fetched:
+            SCAN_STATS.record_fetch(flat.nbytes)
+        if self.fold_plan is not None:
+            # the vector IS an accumulator already covering fold_filled
+            # chunks: unflatten and merge (a second drain only happens
+            # when a stream overflowed the gather capacity)
+            partials = self.fold_plan.unflatten_host(flat, self.fold_filled)
+            SCAN_STATS.chunks_processed += self.fold_filled
+        else:
+            partials = _unflatten_partials(flat, self.shapes)
+            SCAN_STATS.chunks_processed += 1
         if self.merged is None:
             self.merged = list(partials)
         else:
@@ -944,10 +1263,14 @@ class DeferredScan:
         in_flight,
         t_start: float,
         bill_from_start: bool = False,
+        deadline: Optional[float] = None,
     ):
         self._folder = folder
         self._in_flight = in_flight
         self._t_start = t_start
+        # the run's watchdog deadline, carried so a batched fetch
+        # (fetch_deferred) stays guarded like the per-scan drain
+        self._deadline = deadline
         # resolved-inline scans (run_scan defer=False) bill the whole
         # pack+dispatch+drain wall as before; genuinely deferred scans
         # bill only the BLOCKING drain segment — wall between dispatch
@@ -999,18 +1322,54 @@ def fetch_deferred(scans: Sequence["DeferredScan"]) -> None:
         return
     t0 = _time.time()
     arrays = [a for s in pending for a in s._in_flight]
-    if len(arrays) == 1:
-        host = np.asarray(arrays[0])
-        parts = [host]
-    else:
-        sizes = [int(a.shape[0]) for a in arrays]
-        cat = jnp.concatenate(arrays)
-        host = np.asarray(cat)  # the one round trip
+    # a CPU-fallback scan's accumulator is committed to the CPU backend
+    # while its siblings sit on the accelerator — cross-device arrays
+    # cannot concatenate, so a mixed window (rare: only around a
+    # fallback) fetches per array instead of coalescing
+    def _dev_key(a):
+        try:
+            return tuple(sorted(str(d) for d in a.devices()))
+        except Exception:  # noqa: BLE001 — non-jax array
+            return None
+
+    same_device = len({_dev_key(a) for a in arrays}) <= 1
+    # the watchdog deadline travels with the scans (per-run
+    # device_deadline), falling back to the process-wide env default —
+    # this blocking fetch is where async faults and hangs surface now
+    deadline = next(
+        (s._deadline for s in pending if s._deadline is not None),
+        default_device_deadline(),
+    )
+
+    def materialize():
+        if len(arrays) == 1:
+            return [np.asarray(arrays[0])]
+        if not same_device:
+            return [np.asarray(a) for a in arrays]
+        host = np.asarray(jnp.concatenate(arrays))  # the one round trip
         parts = []
         off = 0
-        for size in sizes:
+        for a in arrays:
+            size = int(a.shape[0])
             parts.append(host[off:off + size])
             off += size
+        return parts
+
+    # the coalesced fetch is a device boundary like any other: classify
+    # async faults typed and keep the watchdog armed (a hung device at
+    # this blocking fetch must become DeviceHangException, not a freeze)
+    parts = device_call(
+        materialize, "fetch", what="deferred scan fetch", deadline=deadline,
+    )
+    # the batched round trip is a drain wait and a device->host fetch like
+    # any other — attribute it so the one-fetch contract stays observable
+    # (the per-scan folder.drain calls below see numpy slices and count
+    # nothing)
+    SCAN_STATS.drain_wait_seconds += _time.time() - t0
+    SCAN_STATS.device_fetches += (
+        len(arrays) if (len(arrays) > 1 and not same_device) else 1
+    )
+    SCAN_STATS.bytes_fetched += sum(p.nbytes for p in parts)
     i = 0
     for s in pending:
         n_parts = len(s._in_flight)
@@ -1042,6 +1401,20 @@ MIN_BISECT_CHUNK_ROWS = 64
 _SCAN_IDS = itertools.count()
 
 
+def _block_throttle(arr) -> None:
+    """Wait for a device result WITHOUT fetching it (pipeline
+    backpressure for the device-fold loops). The wait is a drain in the
+    accounting sense — time blocked on the device — but moves no bytes
+    and counts no fetch."""
+    import time as _time
+
+    t0 = _time.time()
+    try:
+        jax.block_until_ready(arr)
+    finally:
+        SCAN_STATS.drain_wait_seconds += _time.time() - t0
+
+
 def _cpu_fallback_device():
     """The CPU device the fallback re-jits on, or None when the process
     has no CPU backend (e.g. JAX_PLATFORMS pinned to the accelerator
@@ -1062,8 +1435,11 @@ def _evict_device_cache(table) -> int:
         return 0
     freed = cache.nbytes
     # drop the buffers eagerly — the WeakSet entry dies with the cache,
-    # but the device arrays must not wait for a GC cycle mid-OOM
+    # but the device arrays must not wait for a GC cycle mid-OOM (the
+    # stacked fused-loop copy and any in-flight fold accumulator die
+    # with the residency: a bisected retry starts a fresh fold)
     cache.device_chunks = []
+    cache._stacked = None
     cache.programs.clear()
     table._device_cache = None
     return freed
@@ -1077,6 +1453,7 @@ def run_scan(
     defer: bool = False,
     on_device_error: str = "fail",
     device_deadline: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1084,6 +1461,17 @@ def run_scan(
     Returns one reduced numpy pytree per op — or, with ``defer=True`` (in-
     memory tables only), a ``DeferredScan`` whose ``result()`` fetches
     them later.
+
+    When every op is ``device_foldable`` the per-chunk partials merge ON
+    DEVICE (left-to-right chunk order) and the whole pass performs
+    exactly one device->host fetch of the final flat state vector — the
+    one-fetch-per-scan contract, observable as
+    ``SCAN_STATS.device_fetches``. Ops with ``compact()`` hooks keep the
+    host fold (one fetch per chunk); ``DEEQU_TPU_DEVICE_FOLD=0`` forces
+    the host fold everywhere.
+
+    ``window`` bounds in-flight chunks (pipelined dispatch); default 3,
+    overridable process-wide via ``DEEQU_TPU_SCAN_WINDOW``.
 
     Device-fault policy (in-memory tables; ops/device_policy.py):
 
@@ -1116,6 +1504,7 @@ def run_scan(
         mesh = current_mesh()
     if device_deadline is None:
         device_deadline = default_device_deadline()
+    window = _resolve_scan_window(window)
     scan_id = next(_SCAN_IDS)
     if getattr(table, "is_streaming", False):
         if defer:
@@ -1126,6 +1515,7 @@ def run_scan(
         return _run_scan_stream(
             table, ops, chunk_rows, mesh,
             scan_id=scan_id, device_deadline=device_deadline,
+            window=window,
         )
 
     chunk_override = chunk_rows
@@ -1168,11 +1558,11 @@ def run_scan(
                     # accelerator deadline was never sized for
                     return _run_scan_once(
                         table, ops, chunk_override, None, defer,
-                        None, scan_ctx, report,
+                        None, scan_ctx, report, window,
                     )
             result = _run_scan_once(
                 table, ops, chunk_override, mesh, defer,
-                device_deadline, scan_ctx, report,
+                device_deadline, scan_ctx, report, window,
             )
             DEVICE_HEALTH.record_success()
             return result
@@ -1242,6 +1632,7 @@ def _run_scan_once(
     device_deadline: Optional[float],
     scan_ctx: Dict[str, Any],
     report: Dict[str, Any],
+    window: int = DEFAULT_SCAN_WINDOW,
 ) -> List[Any]:
     """One attempt of the fused in-memory scan (the pre-fault-tolerance
     run_scan body, instrumented at the three device boundaries).
@@ -1299,7 +1690,7 @@ def _run_scan_once(
         cached_prog = _GLOBAL_PROGRAMS.get(global_key)
 
     if cached_prog is not None:
-        step_fn, shapes0 = cached_prog
+        step_fn, shapes0, raw_flat = cached_prog
         shape_fn = None
         SCAN_STATS.programs_reused += 1
     else:
@@ -1307,7 +1698,7 @@ def _run_scan_once(
         SCAN_STATS.programs_built += 1
         # the trace closure captures a metadata-only view, never the column
         # arrays — cached programs must not pin batches in host memory
-        step_fn, shape_fn = _build_step_fns(
+        step_fn, shape_fn, raw_flat = _build_step_fns(
             ops, packer.unpack_view(), mesh, local_n,
             tuple(sorted(lut_arrays)),
         )
@@ -1317,7 +1708,11 @@ def _run_scan_once(
 
     folder = _PartialFolder(ops)
     folder.shapes = shapes0
-    n_chunks = max(1, (n_rows + chunk - 1) // chunk)
+    n_chunks = (
+        len(cache.device_chunks)
+        if cache is not None
+        else max(1, (n_rows + chunk - 1) // chunk)
+    )
 
     # pipelined dispatch: transfers go through explicit async device_put
     # (one bulk transfer per buffer — the jit arg-conversion path can
@@ -1329,36 +1724,143 @@ def _run_scan_once(
 
     t_start = _time.time()
     in_flight = []
-    window = 3
+    # on-device partial fold: the per-chunk state vectors merge into ONE
+    # device-resident accumulator (exact left-to-right chunk order), so
+    # the whole scan fetches once — per-chunk fetches pay the tunnel
+    # round-trip floor each, which BENCH_r05 measured as ~98% of wall.
+    # A single-chunk scan is already one fetch: folding it would only add
+    # a merge dispatch (a round trip on serialized links), so skip it.
+    # Gather-leaf ops cap at MAX_FOLD_CAPACITY chunks (the gather region
+    # scales with the chunk count — see the constant's rationale).
+    has_gather = any(
+        tag == "gather" for op in ops for tag in jax.tree.leaves(op.tags)
+    )
+    use_fold = (
+        n_chunks > 1
+        and (not has_gather or n_chunks <= MAX_FOLD_CAPACITY)
+        and _device_fold_enabled()
+        and all(device_foldable(op) for op in ops)
+    )
+    plan: Optional[_DeviceFoldPlan] = None
+    acc = None
+    folded = 0
+
+    def fold_chunk(flat, ci):
+        nonlocal plan, acc, folded
+        if plan is None:
+            plan = _fold_plan_for(ops, folder.shapes, n_chunks)
+            acc = plan.fresh_init()
+        acc = device_call(
+            lambda: plan.merge(acc, flat),
+            "execute", what=f"chunk {ci} fold", deadline=device_deadline,
+        )
+        folded += 1
+
     if cache is not None:
         SCAN_STATS.resident_passes += 1
         SCAN_STATS.bytes_resident += cache.nbytes
-        for ci, args in enumerate(cache.device_chunks):
+
+        def ensure_shapes(args):
             if folder.shapes is None:
                 folder.shapes = device_call(
                     lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
                     "trace", what="fused-scan trace",
                 )
                 if prog_key is not None:
-                    cache.put_program(prog_key, (step_fn, folder.shapes))
+                    cache.put_program(
+                        prog_key, (step_fn, folder.shapes, raw_flat)
+                    )
                 if global_key is not None:
-                    _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
+                    _GLOBAL_PROGRAMS.put(
+                        global_key, (step_fn, folder.shapes, raw_flat)
+                    )
+
+        # fused resident loop: one jitted lax.scan over the stacked
+        # resident chunks — per-chunk partials never exist as separate
+        # dispatches, the whole pass is ONE dispatch + ONE fetch
+        fused = None
+        stacked = None
+        if use_fold and mesh is None and n_chunks > 1 and _fused_resident_enabled():
+            # the stack is the largest new HBM allocation of the scan (a
+            # second copy of the table): run it at the execute boundary
+            # so a real RESOURCE_EXHAUSTED raises TYPED and feeds the
+            # same eviction/bisection policy as any other device OOM
+            stacked = device_call(
+                cache.stacked_chunks, "execute",
+                what="resident chunk stack", deadline=device_deadline,
+            )
+            if stacked is not None:
+                ensure_shapes(cache.device_chunks[0])
+                plan = _fold_plan_for(ops, folder.shapes, n_chunks)
+                fused_key = (
+                    ("fused", prog_key, n_chunks)
+                    if prog_key is not None
+                    else None
+                )
+                fused = (
+                    cache.get_program(fused_key) if fused_key else None
+                )
+                if fused is None:
+                    SCAN_STATS.programs_built += 1
+                    fplan, rflat = plan, raw_flat
+
+                    def _fused(stacked_bufs, luts):
+                        def body(acc_c, chunk_args):
+                            flat = rflat(*chunk_args, luts)
+                            return fplan.merge_body(acc_c, flat), None
+
+                        out, _ = jax.lax.scan(
+                            body, jnp.asarray(fplan._init_np), stacked_bufs
+                        )
+                        return out
+
+                    fused = jax.jit(_fused)
+                    if fused_key:
+                        cache.put_program(fused_key, fused)
+                else:
+                    SCAN_STATS.programs_reused += 1
+        if fused is not None:
             t_d = _time.time()
-            in_flight.append(
-                device_call(
+            acc = device_call(
+                lambda: fused(stacked, lut_arrays),
+                "execute", what="fused resident scan dispatch",
+                deadline=device_deadline,
+                hook_ctx={**scan_ctx, "chunk_index": 0},
+            )
+            SCAN_STATS.dispatch_seconds += _time.time() - t_d
+            folded = n_chunks
+        else:
+            for ci, args in enumerate(cache.device_chunks):
+                ensure_shapes(args)
+                t_d = _time.time()
+                flat = device_call(
                     lambda: step_fn(*args, lut_arrays),
                     "execute", what=f"chunk {ci} dispatch",
                     deadline=device_deadline,
                     hook_ctx={**scan_ctx, "chunk_index": ci},
                 )
-            )
-            SCAN_STATS.dispatch_seconds += _time.time() - t_d
-            if len(in_flight) >= window:
-                device_call(
-                    lambda: folder.drain(in_flight.pop(0)),
-                    "execute", what=f"chunk drain (window at {ci})",
-                    deadline=device_deadline,
-                )
+                SCAN_STATS.dispatch_seconds += _time.time() - t_d
+                if use_fold:
+                    fold_chunk(flat, ci)
+                    # same backpressure as the packing loop: queued
+                    # device work stays window-bounded, no fetch
+                    in_flight.append(flat)
+                    if len(in_flight) >= window:
+                        oldest = in_flight.pop(0)
+                        device_call(
+                            lambda: _block_throttle(oldest),
+                            "execute",
+                            what=f"chunk throttle (window at {ci})",
+                            deadline=device_deadline,
+                        )
+                else:
+                    in_flight.append(flat)
+                    if len(in_flight) >= window:
+                        device_call(
+                            lambda: folder.drain(in_flight.pop(0)),
+                            "execute", what=f"chunk drain (window at {ci})",
+                            deadline=device_deadline,
+                        )
     else:
         for ci in range(n_chunks):
             start = ci * chunk
@@ -1371,35 +1873,57 @@ def _run_scan_once(
                     "trace", what="fused-scan trace",
                 )
                 if global_key is not None:
-                    _GLOBAL_PROGRAMS.put(global_key, (step_fn, folder.shapes))
+                    _GLOBAL_PROGRAMS.put(
+                        global_key, (step_fn, folder.shapes, raw_flat)
+                    )
             t_d = _time.time()
             device_args = device_call(
                 lambda: put(args), "transfer",
                 what=f"chunk {ci} transfer", deadline=device_deadline,
             )
-            in_flight.append(
-                device_call(
-                    lambda: step_fn(*device_args, lut_arrays),
-                    "execute", what=f"chunk {ci} dispatch",
-                    deadline=device_deadline,
-                    hook_ctx={**scan_ctx, "chunk_index": ci},
-                )
+            flat = device_call(
+                lambda: step_fn(*device_args, lut_arrays),
+                "execute", what=f"chunk {ci} dispatch",
+                deadline=device_deadline,
+                hook_ctx={**scan_ctx, "chunk_index": ci},
             )
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
-            if len(in_flight) >= window:
-                device_call(
-                    lambda: folder.drain(in_flight.pop(0)),
-                    "execute", what=f"chunk drain (window at {ci})",
-                    deadline=device_deadline,
-                )
-    deferred = DeferredScan(folder, in_flight, t_start, bill_from_start=not defer)
+            if use_fold:
+                fold_chunk(flat, ci)
+                # throttle, don't drain: block on (not fetch) the oldest
+                # chunk's result so pinned host buffers / queued device
+                # work stay window-bounded while zero fetches happen
+                in_flight.append(flat)
+                if len(in_flight) >= window:
+                    oldest = in_flight.pop(0)
+                    device_call(
+                        lambda: _block_throttle(oldest),
+                        "execute", what=f"chunk throttle (window at {ci})",
+                        deadline=device_deadline,
+                    )
+            else:
+                in_flight.append(flat)
+                if len(in_flight) >= window:
+                    device_call(
+                        lambda: folder.drain(in_flight.pop(0)),
+                        "execute", what=f"chunk drain (window at {ci})",
+                        deadline=device_deadline,
+                    )
+    if use_fold and acc is not None:
+        folder.fold_plan = plan
+        folder.fold_filled = folded
+        in_flight = [acc]
+    deferred = DeferredScan(
+        folder, in_flight, t_start, bill_from_start=not defer,
+        deadline=device_deadline,
+    )
     if defer:
         return deferred
     # the drain is the blocking device round trip — the watchdog's prime
     # target (folder.drain classifies fetch errors; device_call adds the
     # hang deadline on top)
     return device_call(
-        deferred.result, "execute", what="scan drain",
+        deferred.result, "fetch", what="scan drain",
         deadline=device_deadline,
     )
 
@@ -1431,6 +1955,8 @@ class DeferredGroupScan:
             t0 = _time.time()
             try:
                 host = np.asarray(self._device_out)  # the one round trip
+                SCAN_STATS.drain_wait_seconds += _time.time() - t0
+                SCAN_STATS.record_fetch(host.nbytes)
                 out = []
                 for k, folder in enumerate(self._folders):
                     folder.drain(host[k])
@@ -1770,6 +2296,7 @@ def _run_scan_stream(
     mesh,
     scan_id: int = -1,
     device_deadline: Optional[float] = None,
+    window: int = DEFAULT_SCAN_WINDOW,
 ) -> List[Any]:
     """One fused pass over a StreamingTable: batches stream off storage on
     a reader thread, pack into fixed-size chunks, and dispatch with a small
@@ -1818,13 +2345,32 @@ def _run_scan_stream(
 
     folder = _PartialFolder(ops)
     in_flight = []
-    window = 3
     chunk_counter = [0]
+    # on-device partial fold across the WHOLE stream: instead of a fetch
+    # per chunk, the accumulator drains only when its fixed gather
+    # capacity fills (STREAM_FOLD_CAPACITY chunks) and once at the end —
+    # a TB-scale stream fetches O(chunks/capacity) times
+    use_fold = _device_fold_enabled() and all(
+        device_foldable(op) for op in ops
+    )
+    fold_state: Dict[str, Any] = {"plan": None, "acc": None, "filled": 0}
+
+    def drain_fold() -> None:
+        if fold_state["acc"] is None:
+            return
+        folder.fold_plan = fold_state["plan"]
+        folder.fold_filled = fold_state["filled"]
+        device_call(
+            lambda: folder.drain(fold_state["acc"]),
+            "fetch", what="stream fold drain", deadline=device_deadline,
+        )
+        fold_state["acc"] = None
+        fold_state["filled"] = 0
     layout: Optional[dict] = None
     # the current (layout, lut signature)'s (step_fn, shapes); reset when
     # either changes (layout upgrades are sticky; LUT shapes change only
     # when a batch dictionary crosses a pow2 size bucket)
-    current_prog: Optional[tuple] = None  # (sig, step_fn, shapes)
+    current_prog: Optional[tuple] = None  # (sig, step_fn, shapes, raw_flat)
 
     import time as _time
 
@@ -1870,12 +2416,12 @@ def _run_scan_stream(
                 prog = current_prog[1:]
 
         if prog is not None:
-            step_fn, shapes = prog
+            step_fn, shapes, raw_flat = prog
             shape_fn = None
             SCAN_STATS.programs_reused += 1
         else:
             SCAN_STATS.programs_built += 1
-            step_fn, shape_fn = _build_step_fns(
+            step_fn, shape_fn, raw_flat = _build_step_fns(
                 ops, packer.unpack_view(), mesh, local_n,
                 tuple(sorted(lut_arrays)),
             )
@@ -1891,9 +2437,11 @@ def _run_scan_stream(
                     "trace", what="fused-stream trace",
                 )
                 if not baked:
-                    current_prog = (sig, step_fn, shapes)
+                    current_prog = (sig, step_fn, shapes, raw_flat)
                     if global_key is not None:
-                        _GLOBAL_PROGRAMS.put(global_key, (step_fn, shapes))
+                        _GLOBAL_PROGRAMS.put(
+                            global_key, (step_fn, shapes, raw_flat)
+                        )
             if folder.shapes is None:
                 folder.shapes = shapes
             t_d = _time.time()
@@ -1902,26 +2450,59 @@ def _run_scan_stream(
                 what=f"stream chunk {chunk_counter[0]} transfer",
                 deadline=device_deadline,
             )
-            in_flight.append(
-                device_call(
-                    lambda: step_fn(*device_args, lut_arrays),
-                    "execute",
-                    what=f"stream chunk {chunk_counter[0]} dispatch",
-                    deadline=device_deadline,
-                    hook_ctx={
-                        "scan_id": scan_id, "attempt": 0, "fallback": False,
-                        "chunk_index": chunk_counter[0],
-                    },
-                )
+            flat = device_call(
+                lambda: step_fn(*device_args, lut_arrays),
+                "execute",
+                what=f"stream chunk {chunk_counter[0]} dispatch",
+                deadline=device_deadline,
+                hook_ctx={
+                    "scan_id": scan_id, "attempt": 0, "fallback": False,
+                    "chunk_index": chunk_counter[0],
+                },
             )
             chunk_counter[0] += 1
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
-            if len(in_flight) >= window:
-                device_call(
-                    lambda: folder.drain(in_flight.pop(0)),
-                    "execute", what="stream chunk drain",
+            if use_fold:
+                if fold_state["plan"] is None:
+                    fold_state["plan"] = _fold_plan_for(
+                        ops, folder.shapes, STREAM_FOLD_CAPACITY
+                    )
+                if fold_state["acc"] is None:
+                    # first chunk, or a fresh accumulator after a
+                    # capacity drain
+                    fold_state["acc"] = fold_state["plan"].fresh_init()
+                plan, acc = fold_state["plan"], fold_state["acc"]
+                fold_state["acc"] = device_call(
+                    lambda: plan.merge(acc, flat),
+                    "execute", what="stream chunk fold",
                     deadline=device_deadline,
                 )
+                fold_state["filled"] += 1
+                in_flight.append(flat)
+                if len(in_flight) >= window:
+                    oldest = in_flight.pop(0)
+                    device_call(
+                        lambda: _block_throttle(oldest),
+                        "execute", what="stream chunk throttle",
+                        deadline=device_deadline,
+                    )
+                # only gather leaves grow with the chunk count: a
+                # gather-free accumulator never overflows, so it folds
+                # the WHOLE stream into one final fetch (and never pays
+                # the restart's f64 sum regrouping)
+                if (
+                    fold_state["filled"] >= STREAM_FOLD_CAPACITY
+                    and plan.gather_size > 0
+                ):
+                    drain_fold()
+            else:
+                in_flight.append(flat)
+                if len(in_flight) >= window:
+                    device_call(
+                        lambda: folder.drain(in_flight.pop(0)),
+                        "execute", what="stream chunk drain",
+                        deadline=device_deadline,
+                    )
             if stop >= n:
                 break
 
@@ -1935,10 +2516,14 @@ def _run_scan_stream(
         # identity partials from one all-padding chunk
         process_cols(_empty_batch_cols(schema, needed), 0)
 
-    for device_result in in_flight:
-        device_call(
-            lambda: folder.drain(device_result),
-            "execute", what="stream tail drain", deadline=device_deadline,
-        )
+    if use_fold:
+        drain_fold()  # the (usually only) fetch of the whole stream scan
+    else:
+        for device_result in in_flight:
+            device_call(
+                lambda: folder.drain(device_result),
+                "execute", what="stream tail drain",
+                deadline=device_deadline,
+            )
     SCAN_STATS.scan_seconds += _time.time() - t_start
     return folder.merged

@@ -52,8 +52,9 @@ def _pad_group_count(g: int) -> int:
 
 
 def _record_fetch(*arrays) -> None:
-    for a in arrays:
-        SCAN_STATS.bytes_fetched += int(a.size) * a.itemsize
+    # one logical device->host materialization (the arrays come back in
+    # one round trip at each call site)
+    SCAN_STATS.record_fetch(sum(int(a.size) * a.itemsize for a in arrays))
 
 
 @jax.jit
@@ -131,7 +132,7 @@ def _device_unique_inverse(
     if n <= SMALL_N_FETCH_LIMIT:
         return single_phase()
     num_uniques = int(nu_dev)
-    SCAN_STATS.bytes_fetched += 8
+    SCAN_STATS.record_fetch(8)
     size = _pad_group_count(num_uniques)
     if size >= n:
         # nearly-all-distinct column: the padded gather fetches more
@@ -244,7 +245,7 @@ def _device_matrix_rle(
         return single_phase()
 
     num_groups, m = (int(x) for x in np.asarray(scalars_dev))
-    SCAN_STATS.bytes_fetched += 16
+    SCAN_STATS.record_fetch(16)
     size = _pad_group_count(num_groups)
     if size >= n:
         # nearly-all-distinct data: the pow2-padded gather would fetch
@@ -831,7 +832,7 @@ def group_count_stats(
     m, num_groups, singletons, clogc = (
         float(x) for x in _rle_stats_kernel(matrix, valid)
     )
-    SCAN_STATS.bytes_fetched += 4 * 8
+    SCAN_STATS.record_fetch(4 * 8)
     num_groups = int(num_groups)
     if num_rows > 0 and num_groups > 0:
         # entropy = -sum (c/N) log(c/N) = log N - (sum c*log c)/N, N = m

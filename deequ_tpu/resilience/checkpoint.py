@@ -162,6 +162,14 @@ class StreamCheckpointer:
         self.saves = 0
         self.save_failures = 0
 
+    def due(self, n_done: int) -> bool:
+        """True when a checkpoint is due after ``n_done`` folded batches —
+        ALSO the point where the runner's deferred device-folded scans
+        must drain: the persisted fold stacks have to cover every batch
+        up to ``n_done``, so device->host fetches happen exactly at
+        checkpoint boundaries instead of once per batch."""
+        return n_done % self.every_batches == 0
+
     def _path(self, batch_index: int) -> str:
         return self._fs.join(self.directory, f"ckpt_{batch_index:010d}.dqck")
 

@@ -40,7 +40,15 @@ class VerificationResult:
       run completed on the fallback backend instead of the accelerator;
     - ``retry_stats`` — aggregate RetryPolicy telemetry for the run
       (invocations, attempts, retries, total backoff sleep, exhaustions,
-      last exception) — retries are no longer invisible to callers."""
+      last exception) — retries are no longer invisible to callers;
+    - ``scan_stats`` — fused-scan transport telemetry for the run
+      (``scan_passes``, ``device_fetches``, ``bytes_fetched``,
+      ``drain_wait_seconds``): the observable for the
+      one-fetch-per-scan contract — for a grouping-free run,
+      ``device_fetches`` exceeding ``scan_passes`` means per-chunk round
+      trips somewhere (a non-device-foldable op, or
+      DEEQU_TPU_DEVICE_FOLD=0); grouping passes add their own bounded
+      O(G) materializations."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
@@ -49,6 +57,7 @@ class VerificationResult:
     device_events: List[dict] = field(default_factory=list)
     fallback_backend: Optional[str] = None
     retry_stats: Dict[str, object] = field(default_factory=dict)
+    scan_stats: Dict[str, object] = field(default_factory=dict)
 
     @staticmethod
     def success_metrics_as_rows(
@@ -171,6 +180,15 @@ class VerificationSuite:
         retry_before = RETRY_TELEMETRY.snapshot()
         events_before = len(SCAN_STATS.degradation_events)
         fallback_before = SCAN_STATS.fallback_scans
+        scan_before = {
+            k: getattr(SCAN_STATS, k)
+            for k in (
+                "scan_passes",
+                "device_fetches",
+                "bytes_fetched",
+                "drain_wait_seconds",
+            )
+        }
 
         analysis_context = AnalysisRunner.do_analysis_run(
             data,
@@ -201,6 +219,12 @@ class VerificationSuite:
         if SCAN_STATS.fallback_scans > fallback_before:
             result.fallback_backend = SCAN_STATS.fallback_backend
         result.retry_stats = RETRY_TELEMETRY.delta_since(retry_before)
+        result.scan_stats = {
+            k: round(getattr(SCAN_STATS, k) - v, 6)
+            if isinstance(v, float)
+            else getattr(SCAN_STATS, k) - v
+            for k, v in scan_before.items()
+        }
 
         if metrics_repository is not None and save_or_append_results_with_key is not None:
             _save_or_append(

@@ -783,3 +783,73 @@ def test_on_device_error_validation():
         VerificationSuite.on_data(table).on_device_error("retry")
     with pytest.raises(ValueError):
         run_scan(table, [], on_device_error="bogus")
+
+
+def test_oom_mid_fold_restarts_device_accumulator_cleanly():
+    """With the on-device partial fold, an OOM injected at a LATER chunk
+    dispatch aborts an accumulator that already holds earlier chunks; the
+    bisected retry must start a FRESH accumulator — no chunk folded
+    twice, metrics identical to a fault-free run, still one fetch."""
+    from deequ_tpu.ops.scan_engine import persist_table
+
+    table = int_table(8192, seed=5)
+    clean = run_scan(
+        table, [a.scan_op(table) for a in basic_analyzers()],
+        chunk_rows=1024,
+    )
+
+    SCAN_STATS.reset()
+    # chunk 3 of attempt 0 OOMs (chunks 0-2 already merged into the
+    # accumulator); the bisected retry rescans everything at chunk 512
+    hook_obj = FaultInjectingScanHook(faults={0: ("oom", 1)})
+    with scan_faults(
+        lambda boundary, ctx: (
+            hook_obj(boundary, ctx)
+            if int(ctx.get("chunk_index", -1)) == 3
+            else None
+        )
+    ):
+        result = run_scan(
+            table, [a.scan_op(table) for a in basic_analyzers()],
+            chunk_rows=1024,
+        )
+    assert SCAN_STATS.oom_bisections == 1
+    for got, want in zip(result, clean):
+        for g, w in zip(
+            np.asarray(list(got.values()) if isinstance(got, dict) else [got]),
+            np.asarray(
+                list(want.values()) if isinstance(want, dict) else [want]
+            ),
+        ):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # the retry's fetch is the scan's only one (the aborted attempt's
+    # accumulator was discarded, never drained)
+    assert SCAN_STATS.device_fetches == 1
+
+
+def test_fused_resident_scan_survives_injected_oom():
+    """An OOM at the fused single-dispatch resident loop evicts the
+    stacked residency and bisects like any other scan — correct metrics,
+    recorded degradation."""
+    from deequ_tpu.ops.scan_engine import persist_table
+
+    table = int_table(8192, seed=6)
+    clean = run_scan(
+        table, [a.scan_op(table) for a in basic_analyzers()],
+        chunk_rows=1024,
+    )
+    persist_table(table, chunk_rows=1024)
+    assert table._device_cache is not None
+
+    SCAN_STATS.reset()
+    with scan_faults(FaultInjectingScanHook(faults={0: ("oom", 1)})):
+        result = run_scan(
+            table, [a.scan_op(table) for a in basic_analyzers()],
+        )
+    assert table._device_cache is None  # residency (and stack) evicted
+    assert SCAN_STATS.oom_bisections == 1
+    for got, want in zip(result, clean):
+        gl = list(got.values()) if isinstance(got, dict) else [got]
+        wl = list(want.values()) if isinstance(want, dict) else [want]
+        for g, w in zip(gl, wl):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))

@@ -1258,3 +1258,76 @@ def test_empty_stream_resilient_path():
     )
     assert result.status == CheckStatus.SUCCESS
     assert metric_values(result)["Size(where=None)"] == 0.0
+
+
+def test_resilient_loop_fetches_at_checkpoint_boundaries(tmp_path):
+    """The resilient streaming loop defers each batch's fused scan and
+    drains them with ONE coalesced fetch per checkpoint boundary — 16
+    batches checkpointed every 4 cost ~4 scan fetches, not 16 — while
+    metrics stay bit-identical to the undeferred (per-batch, device-
+    folded) semantics."""
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    table = small_table(1600, seed=11)
+    analyzers = [Size(), Completeness("x"), Mean("x"), Maximum("g")]
+
+    plain = AnalysisRunner.do_analysis_run(table, analyzers)
+
+    ck = StreamCheckpointer(str(tmp_path / "ck"), every_batches=4)
+    SCAN_STATS.reset()
+    ctx = AnalysisRunner.do_analysis_run(
+        stream_table(table, batch_rows=100), analyzers, checkpoint=ck
+    )
+    assert SCAN_STATS.scan_passes == 16  # one fused scan per batch
+    # scan-result fetches coalesce at the 4 checkpoint boundaries (the
+    # grouping-free workload does no other device->host materialization)
+    assert SCAN_STATS.device_fetches <= 5, SCAN_STATS.device_fetches
+    assert ck.saves == 4
+    for a in analyzers:
+        va = plain.metric_map[a].value.get()
+        vb = ctx.metric_map[a].value.get()
+        # counts/extrema exact; float sums within folding tolerance of
+        # the single-chunk run
+        assert va == vb or abs(va - vb) <= 1e-12 * max(abs(va), 1.0), (
+            a, va, vb)
+
+
+def test_deferred_batch_scan_failure_isolates_and_run_continues(tmp_path):
+    """A batch whose deferred fold blows up at the drain boundary fails
+    only ITS analyzers' shared scan (sticky, shared-scan rule); the
+    stream completes and non-scan analyzers still succeed."""
+    from deequ_tpu.analyzers import Histogram, Mean, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+
+    table = small_table(800, seed=13)
+    analyzers = [Size(), Mean("x"), Histogram("g")]
+
+    import deequ_tpu.ops.scan_engine as se
+
+    original = se.fetch_deferred
+    calls = {"n": 0}
+
+    def sabotage_first(scans):
+        calls["n"] += 1
+        if calls["n"] == 1 and scans:
+            scans[0]._folder.drain = lambda r: (_ for _ in ()).throw(
+                RuntimeError("injected drain failure")
+            )
+        return original(scans)
+
+    se.fetch_deferred = sabotage_first
+    try:
+        ctx = AnalysisRunner.do_analysis_run(
+            stream_table(table, batch_rows=100), analyzers,
+            checkpoint=StreamCheckpointer(str(tmp_path / "ck2"),
+                                          every_batches=2),
+        )
+    finally:
+        se.fetch_deferred = original
+    # the sabotaged batch's fused scan fails Size and Mean (shared scan)
+    assert ctx.metric_map[analyzers[0]].value.is_failure
+    assert ctx.metric_map[analyzers[1]].value.is_failure
+    # Histogram folds outside the fused scan and survives
+    assert ctx.metric_map[analyzers[2]].value.is_success

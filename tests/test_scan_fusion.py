@@ -703,6 +703,253 @@ def test_sparse_and_dense_grouping_agree_randomized(monkeypatch):
             assert abs(host_stats.entropy - dense_stats.entropy) < 1e-9
 
 
+def _fold_table(n=32_768, seed=3):
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    rng = np.random.default_rng(seed)
+    mask = np.ones(n, dtype=bool)
+    mask[rng.integers(0, n, n // 100)] = False
+    return ColumnarTable([
+        Column("a", DType.FRACTIONAL, values=rng.normal(5.0, 2.0, n),
+               mask=mask),
+        Column("b", DType.INTEGRAL, values=rng.integers(0, 1000, n)),
+    ])
+
+
+def _fold_analyzers():
+    return [
+        Size(), Completeness("a"), Mean("a"), StandardDeviation("a"),
+        Minimum("a"), Maximum("b"), Sum("b"), ApproxCountDistinct("b"),
+    ]
+
+
+def _fold_ops(table, analyzers):
+    ops = [a.scan_op(table) for a in analyzers]
+    for op, a in zip(ops, analyzers):
+        op.cache_key = a
+    return ops
+
+
+def test_multi_chunk_resident_scan_is_one_fetch():
+    """The one-fetch-per-scan contract (ISSUE 4 tentpole): a >=8-chunk
+    device-resident scan of device-foldable ops folds its chunk partials
+    ON device and materializes exactly one device->host result."""
+    from deequ_tpu.ops.scan_engine import persist_table
+
+    table = _fold_table()
+    persist_table(table, chunk_rows=4096)  # 32768/4096 = 8 chunks
+    analyzers = _fold_analyzers()
+    try:
+        SCAN_STATS.reset()
+        ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        assert SCAN_STATS.scan_passes == 1
+        assert SCAN_STATS.resident_passes == 1
+        assert SCAN_STATS.chunks_processed == 8
+        assert SCAN_STATS.device_fetches == 1, SCAN_STATS.device_fetches
+    finally:
+        table.unpersist()
+
+
+def test_device_fold_bit_identical_to_host_fold(monkeypatch):
+    """Device-folded partials (per-chunk merge + gather capacity) must be
+    BIT-identical to the host fold at the same chunking — sum/min/max
+    leaves merge with the same IEEE f64 ops in the same left-to-right
+    order, gather leaves concatenate in the same chunk order."""
+    import jax
+    import numpy as np
+
+    from deequ_tpu.analyzers import Correlation
+    from deequ_tpu.ops.scan_engine import run_scan
+
+    table = _fold_table()
+    analyzers = _fold_analyzers() + [Correlation("a", "b")]
+    ops = _fold_ops(table, analyzers)
+
+    monkeypatch.setenv("DEEQU_TPU_DEVICE_FOLD", "0")
+    SCAN_STATS.reset()
+    host = run_scan(table, ops, chunk_rows=4096)
+    host_fetches = SCAN_STATS.device_fetches
+    assert host_fetches == 8  # one per chunk: what the fold removes
+
+    monkeypatch.setenv("DEEQU_TPU_DEVICE_FOLD", "1")
+    SCAN_STATS.reset()
+    folded = run_scan(table, ops, chunk_rows=4096)
+    assert SCAN_STATS.device_fetches == 1
+    assert SCAN_STATS.chunks_processed == 8
+    for i, (x, y) in enumerate(zip(host, folded)):
+        for ah, af in zip(jax.tree.leaves(x), jax.tree.leaves(y)):
+            ah, af = np.asarray(ah), np.asarray(af)
+            assert ah.dtype == af.dtype, (i, ah.dtype, af.dtype)
+            assert np.array_equal(ah, af, equal_nan=True), (i, ah, af)
+
+
+def test_compact_ops_keep_host_fold_path():
+    """Ops with a compact() hook (KLL) are not device-foldable: the scan
+    keeps the per-chunk host fold (and its per-chunk fetches) and stays
+    correct — nothing regresses for them."""
+    import numpy as np
+
+    from deequ_tpu.analyzers import ApproxQuantile
+    from deequ_tpu.ops.scan_engine import device_foldable
+
+    table = _fold_table(n=16_384)
+    analyzers = [Size(), Mean("a"), ApproxQuantile("a", 0.5)]
+    ops = _fold_ops(table, analyzers)
+    assert not all(device_foldable(op) for op in ops)
+
+    SCAN_STATS.reset()
+    from deequ_tpu.ops.scan_engine import run_scan
+
+    results = run_scan(table, ops, chunk_rows=4096)
+    assert SCAN_STATS.device_fetches == 4  # host fold: one per chunk
+    median = analyzers[2].state_from_scan_result(results[2])
+    assert median is not None
+    # sanity: the sketch median lands near the true one
+    vals = np.sort(table["a"].values[table["a"].mask])
+    assert abs(median.sketch.quantile(0.5) - vals[len(vals) // 2]) < 0.2
+
+
+def test_scan_window_validation_and_env(monkeypatch):
+    """DEEQU_TPU_SCAN_WINDOW / run_scan(window=...) configure the
+    pipelined-dispatch window; invalid values refuse loudly."""
+    import pytest
+
+    from deequ_tpu.ops.scan_engine import (
+        DEFAULT_SCAN_WINDOW,
+        _resolve_scan_window,
+        run_scan,
+    )
+
+    assert _resolve_scan_window() == DEFAULT_SCAN_WINDOW == 3
+    assert _resolve_scan_window(7) == 7
+    monkeypatch.setenv("DEEQU_TPU_SCAN_WINDOW", "5")
+    assert _resolve_scan_window() == 5
+    assert _resolve_scan_window(2) == 2  # explicit argument wins
+    monkeypatch.setenv("DEEQU_TPU_SCAN_WINDOW", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        _resolve_scan_window()
+    monkeypatch.setenv("DEEQU_TPU_SCAN_WINDOW", "soon")
+    with pytest.raises(ValueError, match="integer"):
+        _resolve_scan_window()
+    monkeypatch.delenv("DEEQU_TPU_SCAN_WINDOW")
+
+    table = _fold_table(n=8192)
+    ops = _fold_ops(table, [Size(), Mean("a")])
+    with pytest.raises(ValueError, match=">= 1"):
+        run_scan(table, ops, window=0)
+    # a tight window still computes the right thing (throttle path)
+    one = run_scan(table, ops, chunk_rows=1024, window=1)
+    three = run_scan(table, ops, chunk_rows=1024, window=3)
+    assert float(one[0]["n"]) == float(three[0]["n"]) == 8192
+
+
+def test_fetch_deferred_isolates_one_scans_fold_failure():
+    """One deferred scan's fold raising marks only THAT scan failed at
+    result(); sibling scans drained in the same batched fetch succeed."""
+    import pytest
+
+    from deequ_tpu.ops.scan_engine import fetch_deferred, run_scan
+
+    table = _fold_table(n=8192)
+    analyzers = _fold_analyzers()
+    good = run_scan(table, _fold_ops(table, analyzers), defer=True,
+                    chunk_rows=4096)
+    bad = run_scan(table, _fold_ops(table, analyzers), defer=True,
+                   chunk_rows=2048)
+
+    boom = RuntimeError("injected fold failure")
+
+    def exploding_drain(device_result):
+        raise boom
+
+    bad._folder.drain = exploding_drain
+    fetch_deferred([good, bad])
+
+    results = good.result()  # sibling unaffected
+    assert float(results[0]["n"]) == 8192
+    with pytest.raises(RuntimeError, match="injected fold failure"):
+        bad.result()
+    # non-retryable: a second result() must re-raise, never half-refold
+    with pytest.raises(RuntimeError, match="injected fold failure"):
+        bad.result()
+
+
+def test_fetch_deferred_keyboard_interrupt_marks_scan_failed():
+    """A KeyboardInterrupt mid-drain propagates out of fetch_deferred
+    AND leaves the interrupted scan marked failed (non-retryable): a
+    retry would double-fold the half-drained accumulator."""
+    import pytest
+
+    from deequ_tpu.ops.scan_engine import fetch_deferred, run_scan
+
+    table = _fold_table(n=8192)
+    analyzers = _fold_analyzers()
+    scan = run_scan(table, _fold_ops(table, analyzers), defer=True,
+                    chunk_rows=4096)
+
+    def interrupted_drain(device_result):
+        raise KeyboardInterrupt()
+
+    scan._folder.drain = interrupted_drain
+    with pytest.raises(KeyboardInterrupt):
+        fetch_deferred([scan])
+    assert scan._done
+    with pytest.raises(KeyboardInterrupt):
+        scan.result()
+
+
+def test_streaming_scan_fetches_once(monkeypatch):
+    """The fused streaming pass device-folds across batches: a many-batch
+    stream of device-foldable ops drains once (vs once per chunk), and
+    metrics match the host-folded stream bit-for-bit (same chunking)."""
+    from deequ_tpu.data.streaming import stream_table
+
+    table = _fold_table()
+    analyzers = _fold_analyzers()
+    monkeypatch.setenv("DEEQU_TPU_DEVICE_FOLD", "0")
+    SCAN_STATS.reset()
+    ref = AnalysisRunner.do_analysis_run(stream_table(table, 4096), analyzers)
+    assert SCAN_STATS.device_fetches == 8  # host fold: one per chunk
+
+    monkeypatch.setenv("DEEQU_TPU_DEVICE_FOLD", "1")
+    SCAN_STATS.reset()
+    ctx = AnalysisRunner.do_analysis_run(stream_table(table, 4096), analyzers)
+    assert SCAN_STATS.chunks_processed == 8
+    assert SCAN_STATS.device_fetches == 1
+    for a in analyzers:
+        assert ctx.metric_map[a].value.get() == ref.metric_map[a].value.get(), a
+
+
+def test_stream_fold_capacity_overflow_drains_and_continues(monkeypatch):
+    """A stream longer than the device gather capacity drains mid-flight
+    and keeps folding — gather-leaf analyzers (StdDev) stay EXACT, fetches
+    stay O(chunks/capacity)."""
+    import deequ_tpu.ops.scan_engine as se
+    from deequ_tpu.data.streaming import stream_table
+
+    table = _fold_table()
+    analyzers = _fold_analyzers()
+    monkeypatch.setenv("DEEQU_TPU_DEVICE_FOLD", "0")
+    ref = AnalysisRunner.do_analysis_run(stream_table(table, 4096), analyzers)
+
+    monkeypatch.setenv("DEEQU_TPU_DEVICE_FOLD", "1")
+    monkeypatch.setattr(se, "STREAM_FOLD_CAPACITY", 3)
+    SCAN_STATS.reset()
+    ctx = AnalysisRunner.do_analysis_run(stream_table(table, 4096), analyzers)
+    assert SCAN_STATS.chunks_processed == 8
+    assert SCAN_STATS.device_fetches == 3  # ceil(8/3)
+    for a in analyzers:
+        va = ref.metric_map[a].value.get()
+        vb = ctx.metric_map[a].value.get()
+        # counts/extrema/gathered moments exact; f64 sum leaves may
+        # regroup at the capacity restart (docs/numerics.md) — ulp only
+        assert va == vb or abs(va - vb) <= 1e-12 * max(abs(va), 1.0), (
+            a, va, vb)
+
+
 def test_sparse_gather_falls_back_when_groups_near_rows(monkeypatch):
     """Nearly-all-distinct data: the pow2-padded O(G) gather would fetch
     up to 2n slots, more than the sorted matrix itself — the sparse path
