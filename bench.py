@@ -172,6 +172,83 @@ def measure_oom_bisection_overhead(n_rows: int):
     }
 
 
+def measure_reshard_overhead(n_rows: int):
+    """Mesh-fault degradation cost probe (requires >= 2 devices): the
+    same in-memory analysis timed (a) clean on the full N-device mesh,
+    (b) with a scripted chip loss on its first attempt — the scan
+    reshards onto N-1 devices mid-flight — and (c) healthy on an N-1
+    mesh. reshard_overhead_frac is the one-time recovery cost vs the
+    clean wall; degraded_mesh_rows_per_sec is the steady-state N-1
+    throughput, so MULTICHIP_r* tracks what a chip loss actually costs
+    next to the healthy-mesh number."""
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.ops.device_policy import DEVICE_HEALTH, MESH_HEALTH
+    from deequ_tpu.ops.scan_engine import SCAN_STATS, install_scan_fault_hook
+    from deequ_tpu.parallel.mesh import (
+        current_mesh,
+        mesh_device_ids,
+        mesh_excluding,
+        use_mesh,
+    )
+    from deequ_tpu.resilience import FaultInjectingScanHook, FaultSchedule
+
+    mesh = current_mesh()
+    ids = mesh_device_ids(mesh)
+    if len(ids) < 2:
+        print(
+            "reshard probe skipped: needs >= 2 devices", file=sys.stderr
+        )
+        return {
+            "reshard_overhead_frac": None,
+            "degraded_mesh_rows_per_sec": None,
+        }
+    lost_id = ids[-1]
+
+    table = build_table(n_rows)
+    analyzers = [Size()]
+    for i in range(4):
+        c = f"c{i}"
+        analyzers += [Completeness(c), Mean(c), Minimum(c), Maximum(c)]
+
+    def run(hook=None):
+        prev = install_scan_fault_hook(hook)
+        DEVICE_HEALTH.reset()
+        MESH_HEALTH.reset()  # each rep must reshard live, not pre-shrink
+        t0 = time.time()
+        try:
+            ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+        finally:
+            install_scan_fault_hook(prev)
+        wall = time.time() - t0
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        return wall
+
+    run()  # warmup: compile the fused program on the full mesh
+    clean = min(run(), run())
+    SCAN_STATS.reset()
+    resharded = min(
+        run(FaultInjectingScanHook(
+            faults={0: ("lost", FaultSchedule.PERMANENT, lost_id)}
+        )),
+        run(FaultInjectingScanHook(
+            faults={0: ("lost", FaultSchedule.PERMANENT, lost_id)}
+        )),
+    )
+    assert SCAN_STATS.mesh_reshards >= 2, "probe failed to trigger reshard"
+    assert SCAN_STATS.fallback_scans == 0, "probe fell back to CPU"
+    MESH_HEALTH.reset()
+    with use_mesh(mesh_excluding(mesh, {lost_id})):
+        run()  # warmup: the N-1 program is a fresh compile
+        degraded = min(run(), run())
+    return {
+        "reshard_overhead_frac": round(
+            max(resharded - clean, 0.0) / max(clean, 1e-9), 4
+        ),
+        "degraded_mesh_rows_per_sec": round(n_rows / max(degraded, 1e-9), 1),
+    }
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -278,7 +355,9 @@ def main():
     print(f"checkpoint probe: {ckpt_probe}", file=sys.stderr)
     oom_probe = measure_oom_bisection_overhead(SMOKE_ROWS if smoke else 200_000)
     print(f"oom bisection probe: {oom_probe}", file=sys.stderr)
-    ckpt_probe = {**ckpt_probe, **oom_probe}
+    reshard_probe = measure_reshard_overhead(SMOKE_ROWS if smoke else 200_000)
+    print(f"reshard probe: {reshard_probe}", file=sys.stderr)
+    ckpt_probe = {**ckpt_probe, **oom_probe, **reshard_probe}
 
     if smoke:
         print(

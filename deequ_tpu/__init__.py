@@ -70,6 +70,8 @@ from deequ_tpu.exceptions import (  # noqa: E402
     DeviceHangException,
     DeviceLostException,
     DeviceOOMException,
+    MeshDegradedException,
+    PeerLostException,
 )
 from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
 from deequ_tpu.verification import (  # noqa: E402
@@ -106,6 +108,8 @@ __all__ = [
     "DeviceCompileException",
     "DeviceLostException",
     "DeviceHangException",
+    "MeshDegradedException",
+    "PeerLostException",
     "DoubleMetric",
     "Entity",
     "HistogramMetric",

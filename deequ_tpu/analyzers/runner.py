@@ -168,6 +168,7 @@ class AnalysisRunner:
         retry_policy=None,
         on_device_error: str = "fail",
         device_deadline=None,
+        shard_deadline=None,
     ) -> AnalyzerContext:
         """``group_memory_budget`` (bytes; also settable per-table via
         ``StreamingTable.with_group_memory_budget`` or the
@@ -271,6 +272,7 @@ class AnalysisRunner:
                 retry_policy=retry_policy,
                 on_device_error=on_device_error,
                 device_deadline=device_deadline,
+                shard_deadline=shard_deadline,
             )
             result = results_loaded + failure_ctx + resilient_ctx
             _save_or_append_result(
@@ -282,6 +284,7 @@ class AnalysisRunner:
         scan_ctx = AnalysisRunner._run_scanning_analyzers(
             data, scanning, aggregate_with, save_states_with,
             on_device_error=on_device_error, device_deadline=device_deadline,
+            shard_deadline=shard_deadline,
         )
 
         # own-pass analyzers (KLL extra pass analogue, reference L155-160);
@@ -429,6 +432,7 @@ class AnalysisRunner:
         defer: bool = False,
         on_device_error: str = "fail",
         device_deadline=None,
+        shard_deadline=None,
     ):
         """Build + dispatch the fused scan. Returns (ctx_with_failures,
         scannable, plan, scan) where scan is the results list (or a
@@ -449,6 +453,7 @@ class AnalysisRunner:
                 data, exec_ops, defer=defer,
                 on_device_error=on_device_error,
                 device_deadline=device_deadline,
+                shard_deadline=shard_deadline,
             )
         except Exception as e:  # noqa: BLE001 — a failure inside the shared
             # scan maps onto every participating analyzer (reference L320-323)
@@ -491,12 +496,14 @@ class AnalysisRunner:
         save_states_with=None,
         on_device_error: str = "fail",
         device_deadline=None,
+        shard_deadline=None,
     ) -> AnalyzerContext:
         ctx, scannable, plan, scan = (
             AnalysisRunner._dispatch_scanning_analyzers(
                 data, analyzers,
                 on_device_error=on_device_error,
                 device_deadline=device_deadline,
+                shard_deadline=shard_deadline,
             )
         )
         if scan is None:
@@ -601,6 +608,7 @@ class AnalysisRunner:
         retry_policy=None,
         on_device_error: str = "fail",
         device_deadline=None,
+        shard_deadline=None,
     ) -> AnalyzerContext:
         """One resilient batch loop over the stream for EVERY analyzer
         class (scan-shareable / own-pass / grouping), with host-resident
@@ -858,6 +866,7 @@ class AnalysisRunner:
                         batch, alive_scan, defer=True,
                         on_device_error=on_device_error,
                         device_deadline=device_deadline,
+                        shard_deadline=shard_deadline,
                     )
                 )
                 failed.update(sctx.metric_map)

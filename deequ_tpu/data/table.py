@@ -192,8 +192,12 @@ class ColumnarTable:
         return self
 
     def unpersist(self) -> "ColumnarTable":
-        """Release the device-resident buffers."""
-        self._device_cache = None
+        """Release the device-resident buffers (eagerly: the buffers are
+        dropped and the cache's HBM-budget accounting zeroed now, not at
+        the next GC cycle of whoever else holds the cache object)."""
+        from deequ_tpu.ops.scan_engine import _evict_device_cache
+
+        _evict_device_cache(self)
         return self
 
     @property

@@ -15,7 +15,7 @@ never have to pattern-match runtime strings.
 from __future__ import annotations
 
 import re
-from typing import Optional
+from typing import Optional, Tuple
 
 
 class MetricCalculationException(Exception):
@@ -122,11 +122,19 @@ class DeviceException(MetricCalculationRuntimeException):
     (dispatch / block_until_ready), or ``"fetch"`` (the device->host
     result materialization — with the on-device partial fold this is
     where ASYNC execute failures surface, since it is the scan's one
-    blocking round trip)."""
+    blocking round trip).
 
-    def __init__(self, message: str, boundary: str = "execute"):
+    ``device_ids`` names the mesh members the raw error implicated (XLA
+    messages often carry the failing chip: "device 3", "TPU_2", "chip
+    #5"); empty when the fault is unattributable. Attribution is what
+    lets the degraded-mesh policy shrink the mesh around ONE dead chip
+    instead of abandoning all of them."""
+
+    def __init__(self, message: str, boundary: str = "execute",
+                 device_ids: Tuple[int, ...] = ()):
         super().__init__(message)
         self.boundary = boundary
+        self.device_ids = tuple(device_ids)
 
 
 class DeviceOOMException(DeviceException):
@@ -159,6 +167,30 @@ class DeviceHangException(DeviceException):
         self.deadline = deadline
 
 
+class MeshDegradedException(DeviceException):
+    """A collective-boundary failure on a multi-chip mesh attributable to
+    specific mesh members (``device_ids``): one chip's shard faulted while
+    the rest of the mesh is presumed healthy. The degraded-mesh policy in
+    ``run_scan`` responds by evicting residency pinned to the implicated
+    devices, rebuilding the mesh over the largest healthy subset, and
+    re-dispatching the same fused program — the CPU fallback is reached
+    only when NO accelerator subset remains."""
+
+
+class PeerLostException(DeviceException):
+    """A multi-host run lost contact with one or more peer processes
+    (barrier/heartbeat timeout across the DCN tier). ``lost_processes``
+    names the process indices that stopped responding (empty when the
+    timeout could not be attributed). With ``on_peer_loss="degrade"`` the
+    surviving hosts complete the run and the lost hosts' row ranges are
+    reported unverified instead of raising this."""
+
+    def __init__(self, message: str, lost_processes: Tuple[int, ...] = (),
+                 boundary: str = "execute"):
+        super().__init__(message, boundary)
+        self.lost_processes = tuple(lost_processes)
+
+
 # message patterns per class, checked in order — OOM first (an OOM during
 # compilation must bisect, not fall back), then compile, then lost
 _OOM_RE = re.compile(
@@ -176,6 +208,33 @@ _LOST_RE = re.compile(
     r"[Ff]ailed to initialize|[Nn]o visible.*devic|TPU.*unavailable",
     re.DOTALL,
 )
+
+# device attribution: XLA/runtime messages that name the failing chip do
+# so with a handful of SINGULAR shapes ("device 3", "device: 3", "TPU_2",
+# "TPU:2", "chip #5", "mesh position 4", "core 1"). The word prefix keeps
+# byte counts and addresses from parsing as device ids, and the prefix is
+# deliberately singular-only: enumeration text in whole-backend failures
+# ("visible devices: 0,1") names the SET, not a culprit, and must not
+# misattribute a backend-wide loss to its first listed chip
+_DEVICE_ID_RE = re.compile(
+    r"(?:device|TPU|chip|core|mesh position)[ _:#]+(\d+)",
+    re.IGNORECASE,
+)
+
+
+def implicated_devices(exception: BaseException) -> Tuple[int, ...]:
+    """The device ids a raw error message names, in order, deduplicated.
+    Empty when the failure is unattributable (whole-backend faults,
+    allocator OOMs that don't say where)."""
+    if isinstance(exception, DeviceException) and exception.device_ids:
+        return exception.device_ids
+    text = f"{type(exception).__name__}: {exception}"
+    seen = []
+    for m in _DEVICE_ID_RE.finditer(text):
+        did = int(m.group(1))
+        if did not in seen:
+            seen.append(did)
+    return tuple(seen)
 
 
 def _device_error_strength(exception: BaseException) -> Optional[str]:
@@ -215,13 +274,18 @@ def classify_device_error(
     if strength is None:
         return None
     text = f"{type(exception).__name__}: {exception}"
+    device_ids = implicated_devices(exception)
     klass = None
     if isinstance(exception, MemoryError) or _OOM_RE.search(text):
         klass = DeviceOOMException
     elif _COMPILE_RE.search(text):
         klass = DeviceCompileException
     elif _LOST_RE.search(text):
-        klass = DeviceLostException
+        # a loss the message pins on specific chips is a MESH fault — the
+        # rest of the mesh is presumed healthy and the degraded-mesh
+        # policy can shrink around the dead member(s); an unattributed
+        # loss stays a whole-backend DeviceLostException
+        klass = MeshDegradedException if device_ids else DeviceLostException
     elif boundary == "trace" and strength == "strong":
         # an unrecognized jax/jaxlib failure while tracing/compiling is a
         # compile failure by position: the program never ran
@@ -229,6 +293,7 @@ def classify_device_error(
     if klass is None:
         return None
     typed = klass(f"[{boundary}] {text}", boundary=boundary)
+    typed.device_ids = device_ids
     typed.__cause__ = exception
     return typed
 

@@ -19,11 +19,16 @@ arXiv:1703.08219). This module is the engine-side half of ours:
   resilience tests drive (``resilience/faults.py:FaultInjectingScanHook``);
 - :class:`DeviceHealth` counts classified faults so a backend that
   REPEATEDLY faults routes subsequent scans straight to the CPU fallback
-  instead of re-failing first every time.
+  instead of re-failing first every time;
+- :class:`MeshHealth` is the same idea at MESH-MEMBER granularity: faults
+  attributable to one chip (``DeviceException.device_ids``) cost that
+  chip, not the backend — quarantined chips are excluded from future
+  meshes up front, with half-open probes readmitting them periodically.
 
-The degradation policies themselves (chunk bisection, CPU re-jit) live in
-``ops/scan_engine.py:run_scan`` — this module only decides *what* failed
-and *whether* the backend is still trusted.
+The degradation policies themselves (chunk bisection, degraded-mesh
+re-sharding, CPU re-jit) live in ``ops/scan_engine.py:run_scan`` — this
+module only decides *what* failed and *whether* the backend (or the
+chip) is still trusted.
 """
 
 from __future__ import annotations
@@ -67,6 +72,20 @@ def default_device_deadline() -> Optional[float]:
     """Process-wide watchdog deadline (seconds) from
     ``DEEQU_TPU_DEVICE_DEADLINE``; unset/empty/0 disables the watchdog."""
     raw = os.environ.get("DEEQU_TPU_DEVICE_DEADLINE", "")
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    return val if val > 0 else None
+
+
+def default_shard_deadline() -> Optional[float]:
+    """Process-wide per-shard dispatch deadline (seconds) from
+    ``DEEQU_TPU_SHARD_DEADLINE``, armed only on MULTI-CHIP mesh scans: a
+    straggling chip that stalls a collective past it raises
+    ``DeviceHangException`` (recorded as a ``mesh_straggler`` event)
+    instead of freezing the whole mesh. Unset/empty/0 disables it."""
+    raw = os.environ.get("DEEQU_TPU_SHARD_DEADLINE", "")
     try:
         val = float(raw)
     except ValueError:
@@ -191,3 +210,97 @@ class DeviceHealth:
 
 #: process-wide accelerator health, read by run_scan's fallback policy
 DEVICE_HEALTH = DeviceHealth()
+
+
+# -- mesh health -------------------------------------------------------------
+
+
+class MeshHealth:
+    """Per-device fault registry for multi-chip meshes — ``DeviceHealth``
+    at mesh-member granularity.
+
+    Every classified device fault that NAMES its chip
+    (``DeviceException.device_ids``) is recorded against that chip, not
+    the whole backend: one flaky chip on an 8-chip mesh must cost one
+    chip, never all eight. A chip whose consecutive faults reach
+    ``threshold`` is quarantined — subsequent scans build their mesh over
+    the healthy remainder up front instead of re-failing into the same
+    dead member — with the same half-open circuit-breaker escape hatch as
+    DeviceHealth: every ``probe_interval``-th quarantine decision
+    readmits the quarantined chips for one probe scan, and a successful
+    pass over a probed chip clears its record (transient weather
+    forgives; a genuinely dead chip re-quarantines on the next fault).
+
+    A ``DeviceLostException`` / ``MeshDegradedException`` quarantines its
+    chips IMMEDIATELY (a lost chip is lost, not flaky); other attributable
+    faults (per-chip OOM, stragglers) count one step toward the
+    threshold."""
+
+    def __init__(self, threshold: int = 2, probe_interval: int = 8):
+        self.threshold = int(threshold)
+        self.probe_interval = int(probe_interval)
+        self.reset()
+
+    def reset(self) -> None:
+        self.consecutive_faults: Dict[int, int] = {}
+        self.total_faults: Dict[int, int] = {}
+        self._filtered = 0
+
+    def record_fault(self, exc: "DeviceException") -> None:
+        """Record one classified fault against every chip it implicates
+        (no-op for unattributable faults — those are DeviceHealth's)."""
+        from deequ_tpu.exceptions import (
+            DeviceLostException,
+            MeshDegradedException,
+        )
+
+        fatal = isinstance(exc, (DeviceLostException, MeshDegradedException))
+        for did in getattr(exc, "device_ids", ()) or ():
+            count = self.consecutive_faults.get(did, 0) + 1
+            if fatal:
+                count = max(count, self.threshold)
+            self.consecutive_faults[did] = count
+            self.total_faults[did] = self.total_faults.get(did, 0) + 1
+
+    def record_success(self, device_ids) -> None:
+        """A scan completed over these chips: their records clear. Only
+        the chips that actually PARTICIPATED are forgiven — a success on
+        the shrunken mesh says nothing about the quarantined member, and
+        must not reset the probe cadence that will eventually retry it."""
+        for did in device_ids:
+            self.consecutive_faults.pop(int(did), None)
+
+    def quarantined(self) -> frozenset:
+        return frozenset(
+            did
+            for did, count in self.consecutive_faults.items()
+            if count >= self.threshold
+        )
+
+    def healthy_subset(self, device_ids):
+        """Partition ``device_ids`` into (healthy, excluded) for a scan
+        about to build its mesh. Advances the half-open probe counter only
+        when something would actually be excluded; on every
+        ``probe_interval``-th such decision the quarantined chips are
+        readmitted for one probe."""
+        bad = self.quarantined()
+        ids = [int(d) for d in device_ids]
+        excluded = [d for d in ids if d in bad]
+        if not excluded:
+            return ids, []
+        self._filtered += 1
+        if self.probe_interval and self._filtered % self.probe_interval == 0:
+            return ids, []  # half-open probe: trust the full mesh this once
+        healthy = [d for d in ids if d not in bad]
+        return healthy, excluded
+
+    def snapshot(self) -> dict:
+        return {
+            "quarantined": sorted(self.quarantined()),
+            "consecutive_faults": dict(self.consecutive_faults),
+            "total_faults": dict(self.total_faults),
+        }
+
+
+#: process-wide per-chip health, read by run_scan's degraded-mesh policy
+MESH_HEALTH = MeshHealth()

@@ -50,11 +50,19 @@ from deequ_tpu.exceptions import (
 from deequ_tpu.expr.eval import Val
 from deequ_tpu.ops.device_policy import (
     DEVICE_HEALTH,
+    MESH_HEALTH,
     default_device_deadline,
+    default_shard_deadline,
     device_call,
     install_scan_fault_hook,  # noqa: F401 — re-exported: the seam lives here
 )
-from deequ_tpu.parallel.mesh import ROW_AXIS, current_mesh, shard_map
+from deequ_tpu.parallel.mesh import (
+    ROW_AXIS,
+    current_mesh,
+    mesh_device_ids,
+    mesh_excluding,
+    shard_map,
+)
 
 DEFAULT_CHUNK_ROWS = 1 << 20
 # target bytes per packed chunk transfer: large enough to amortize the
@@ -260,13 +268,37 @@ class ScanStats:
         self.fallback_scans = 0
         self.fallback_backend = None
         self.degradation_events = []
+        # mesh-fault tolerance (run_scan's degraded-mesh policy +
+        # parallel/distributed.py's peer-loss path): device-attributable
+        # faults seen on a multi-chip mesh, mesh rebuilds over a healthy
+        # subset, straggler-deadline conversions, peers lost across hosts,
+        # and the [start, stop) row ranges a degraded multi-host run
+        # completed WITHOUT verifying (on_peer_loss="degrade")
+        self.mesh_faults = 0
+        self.mesh_reshards = 0
+        self.mesh_stragglers = 0
+        self.peer_losses = 0
+        self.unverified_row_ranges = []
 
     def snapshot(self) -> dict:
         snap = dict(self.__dict__)
         # events are mutable rows — hand out a copy so a caller's report
         # is a point-in-time record, not a live view
         snap["degradation_events"] = [dict(e) for e in self.degradation_events]
+        snap["unverified_row_ranges"] = [
+            tuple(r) for r in self.unverified_row_ranges
+        ]
         return snap
+
+    def record_unverified(self, start: int, stop: int, reason: str) -> dict:
+        """Mark one [start, stop) row range as UNVERIFIED (a degraded
+        multi-host run completed without the lost hosts' shards). The
+        omission is reported, never silent — mirrored onto
+        ``VerificationResult.unverified_row_ranges``."""
+        self.unverified_row_ranges.append((int(start), int(stop)))
+        return self.record_degradation(
+            "peer_lost", start=int(start), stop=int(stop), reason=reason
+        )
 
     def record_fetch(self, nbytes: int) -> None:
         """Account one device->host materialization (the unit the
@@ -705,17 +737,18 @@ class DeviceTableCache:
     def put_program(self, key, prog) -> None:
         self.programs.put(key, prog)
 
-    def matches(self, mesh, needed_cols) -> bool:
-        same_mesh = (
-            (mesh is None and self.mesh is None)
-            or (
-                mesh is not None
-                and self.mesh is not None
-                and mesh.devices.shape == self.mesh.devices.shape
-                and tuple(mesh.devices.flat) == tuple(self.mesh.devices.flat)
-            )
+    def mesh_matches(self, mesh) -> bool:
+        return (mesh is None and self.mesh is None) or (
+            mesh is not None
+            and self.mesh is not None
+            and mesh.devices.shape == self.mesh.devices.shape
+            and tuple(mesh.devices.flat) == tuple(self.mesh.devices.flat)
         )
-        return same_mesh and set(needed_cols) <= set(self.packer.cols)
+
+    def matches(self, mesh, needed_cols) -> bool:
+        return self.mesh_matches(mesh) and (
+            set(needed_cols) <= set(self.packer.cols)
+        )
 
 
 # Live caches (weakly held): persist() checks the COMBINED resident
@@ -1441,6 +1474,12 @@ def _evict_device_cache(table) -> int:
     cache.device_chunks = []
     cache._stacked = None
     cache.programs.clear()
+    # the cache object may outlive the eviction (a caller's reference, a
+    # pending GC cycle): zero its accounting and drop it from the live
+    # set NOW, or total_resident_bytes() keeps charging the HBM budget
+    # for buffers that no longer exist
+    cache.nbytes = 0
+    _ACTIVE_CACHES.discard(cache)
     table._device_cache = None
     return freed
 
@@ -1454,6 +1493,7 @@ def run_scan(
     on_device_error: str = "fail",
     device_deadline: Optional[float] = None,
     window: Optional[int] = None,
+    shard_deadline: Optional[float] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1491,6 +1531,26 @@ def run_scan(
       device call that exceeds it raises ``DeviceHangException`` instead
       of hanging the run.
 
+    Mesh-fault policy (multi-chip meshes; the degraded-mesh ladder is
+    reshard -> bisect -> CPU fallback, and no path falls back to the CPU
+    while a healthy accelerator subset remains):
+
+    - a classified fault that NAMES its mesh member(s)
+      (``MeshDegradedException`` / any ``Device*Exception`` with
+      ``device_ids``) records against ``MESH_HEALTH``, evicts residency
+      pinned to the failed chip(s), rebuilds the mesh over the largest
+      healthy device subset, and re-dispatches the SAME fused program —
+      the monoid fold restarts from scratch on the survivors, so the
+      degraded result is bit-identical to a healthy run on that smaller
+      mesh;
+    - chips ``MESH_HEALTH`` has quarantined are excluded from the mesh
+      UP FRONT (with a half-open probe readmitting them periodically),
+      so a known-dead chip doesn't re-fail every scan first;
+    - ``shard_deadline`` (seconds; default from
+      ``DEEQU_TPU_SHARD_DEADLINE``) arms the straggler watchdog on mesh
+      dispatches: a chip stalling a collective past it raises a typed
+      ``DeviceHangException`` recorded as a ``mesh_straggler`` event.
+
     ``defer=True`` scans dispatch under the same typed boundaries, but
     errors surfacing at ``result()`` are past bisection/fallback — the
     caller holds the only retry point then.
@@ -1504,6 +1564,8 @@ def run_scan(
         mesh = current_mesh()
     if device_deadline is None:
         device_deadline = default_device_deadline()
+    if shard_deadline is None:
+        shard_deadline = default_shard_deadline()
     window = _resolve_scan_window(window)
     scan_id = next(_SCAN_IDS)
     if getattr(table, "is_streaming", False):
@@ -1512,35 +1574,125 @@ def run_scan(
                 "defer=True is for in-memory batch tables; streaming scans "
                 "already pipeline internally"
             )
+        # the straggler deadline arms the stream's mesh dispatches too: a
+        # half-consumed stream cannot reshard (no rewind), but a stalled
+        # collective must still become a TYPED DeviceHangException rather
+        # than a frozen run — use the tighter of the two deadlines
+        stream_deadline = device_deadline
+        if shard_deadline is not None and mesh is not None and (
+            math.prod(mesh.devices.shape) > 1
+        ):
+            stream_deadline = (
+                shard_deadline
+                if device_deadline is None
+                else min(device_deadline, shard_deadline)
+            )
         return _run_scan_stream(
             table, ops, chunk_rows, mesh,
-            scan_id=scan_id, device_deadline=device_deadline,
+            scan_id=scan_id, device_deadline=stream_deadline,
             window=window,
         )
 
     chunk_override = chunk_rows
     attempt = 0
-    n_dev = math.prod(mesh.devices.shape) if mesh is not None else 1
-    floor = max(n_dev, min(MIN_BISECT_CHUNK_ROWS, max(table.num_rows, 1)))
     # fallback needs a CPU backend to land on; a process pinned to the
     # accelerator platform only degrades to raising the typed error
     can_fallback = (
         on_device_error == "fallback" and _cpu_fallback_device() is not None
     )
+
+    def _mesh_size(m) -> int:
+        return math.prod(m.devices.shape) if m is not None else 1
+
+    # chips MESH_HEALTH has quarantined are excluded UP FRONT (half-open:
+    # healthy_subset periodically readmits them as a probe) — a known-dead
+    # mesh member must not re-fail every scan before each reshard
+    mesh_exhausted = False
+    if _mesh_size(mesh) > 1:
+        healthy, excluded = MESH_HEALTH.healthy_subset(mesh_device_ids(mesh))
+        if excluded:
+            shrunk = mesh_excluding(mesh, excluded)
+            if shrunk is not None:
+                SCAN_STATS.record_degradation(
+                    "mesh_quarantine", scan_id=scan_id,
+                    excluded_devices=sorted(excluded),
+                    mesh_from=_mesh_size(mesh), mesh_to=_mesh_size(shrunk),
+                )
+                mesh = shrunk
+            else:
+                # EVERY mesh member is quarantined: no accelerator subset
+                # remains, the CPU fallback is the only degradation left
+                mesh_exhausted = True
     # can_fallback first: should_force_fallback() advances the half-open
     # probe counter and must not run for on_device_error="fail" scans
-    fallback = can_fallback and DEVICE_HEALTH.should_force_fallback()
+    fallback = can_fallback and (
+        DEVICE_HEALTH.should_force_fallback() or mesh_exhausted
+    )
     if fallback:
         SCAN_STATS.record_degradation(
-            "cpu_fallback", scan_id=scan_id, reason="unhealthy_backend",
+            "cpu_fallback", scan_id=scan_id,
+            reason="mesh_exhausted" if mesh_exhausted
+            else "unhealthy_backend",
             consecutive_faults=DEVICE_HEALTH.consecutive_faults,
         )
     depth = 0
     while True:
+        n_dev = _mesh_size(mesh)
+        floor = max(n_dev, min(MIN_BISECT_CHUNK_ROWS, max(table.num_rows, 1)))
+        # straggler watchdog: on a MULTI-chip dispatch the per-shard
+        # deadline bounds how long one stalled chip may hold a collective
+        straggler_armed = shard_deadline is not None and n_dev > 1
+        attempt_deadline = device_deadline
+        if straggler_armed:
+            attempt_deadline = (
+                shard_deadline
+                if device_deadline is None
+                else min(device_deadline, shard_deadline)
+            )
         scan_ctx = {
             "scan_id": scan_id, "attempt": attempt, "fallback": fallback,
+            "device_ids": mesh_device_ids(mesh),
         }
         report: Dict[str, Any] = {}
+
+        def _reshard_after(e: DeviceException) -> bool:
+            """Shrink the mesh around the chip(s) ``e`` implicates; True
+            when a healthy accelerator subset remains and the scan should
+            re-dispatch on it."""
+            nonlocal mesh, chunk_override, depth
+            mesh_ids = set(mesh_device_ids(mesh))
+            lost = [
+                d for d in getattr(e, "device_ids", ()) if d in mesh_ids
+            ]
+            if not lost or len(mesh_ids) <= 1:
+                return False
+            SCAN_STATS.mesh_faults += 1
+            MESH_HEALTH.record_fault(e)
+            new_mesh = mesh_excluding(
+                mesh, set(lost) | set(MESH_HEALTH.quarantined())
+            )
+            if new_mesh is None:
+                return False
+            # residency is pinned (sharded) onto the OLD mesh — including
+            # the dead chip(s); it cannot serve the shrunken mesh
+            freed = _evict_device_cache(table)
+            SCAN_STATS.mesh_reshards += 1
+            SCAN_STATS.record_degradation(
+                "mesh_reshard", scan_id=scan_id,
+                lost_devices=sorted(lost),
+                mesh_from=len(mesh_ids), mesh_to=_mesh_size(new_mesh),
+                evicted_bytes=freed, error=str(e),
+            )
+            mesh = new_mesh
+            # the pressure that drove any bisection left with the chip:
+            # restart at the caller's chunk size, or a per-chip OOM that
+            # bottomed out at the ~64-row floor would pin the WHOLE rest
+            # of the scan at floor-sized dispatches on a healthy mesh (a
+            # recurring OOM on the survivors simply re-bisects)
+            chunk_override = chunk_rows
+            depth = 0
+            return True
+
         try:
             if fallback:
                 SCAN_STATS.fallback_scans += 1
@@ -1562,9 +1714,11 @@ def run_scan(
                     )
             result = _run_scan_once(
                 table, ops, chunk_override, mesh, defer,
-                device_deadline, scan_ctx, report, window,
+                attempt_deadline, scan_ctx, report, window,
             )
             DEVICE_HEALTH.record_success()
+            if n_dev > 1:
+                MESH_HEALTH.record_success(mesh_device_ids(mesh))
             return result
         except DeviceOOMException as e:
             SCAN_STATS.device_faults += 1
@@ -1588,8 +1742,13 @@ def run_scan(
                 chunk_override = halved
                 attempt += 1
                 continue
-            # at the floor (or already on the fallback backend): bisection
-            # cannot help any further
+            # at the bisection floor: a per-CHIP OOM (the message named
+            # its device) can still shed the sick member and retry on the
+            # healthy remainder before any CPU fallback
+            if not fallback and _reshard_after(e):
+                attempt += 1
+                continue
+            # bisection and resharding cannot help any further
             if can_fallback and not fallback:
                 fallback = True
                 attempt += 1
@@ -1601,17 +1760,41 @@ def run_scan(
                 continue
             raise
         except DeviceException as e:
-            # compile / lost / hang: retrying the same program on the same
-            # backend cannot help — fall back or raise typed
             SCAN_STATS.device_faults += 1
-            if not fallback:  # CPU-side faults are not accelerator health
-                DEVICE_HEALTH.record_fault(e)
             if isinstance(e, DeviceHangException):
                 SCAN_STATS.watchdog_timeouts += 1
-                SCAN_STATS.record_degradation(
-                    "watchdog_timeout", scan_id=scan_id,
-                    deadline=e.deadline, error=str(e),
-                )
+                # a hang on a multi-chip dispatch is a straggling
+                # collective only when the PER-SHARD deadline was the one
+                # that bound (attempt_deadline = min of the two): a hang
+                # tripping a tighter device_deadline is a general watchdog
+                # timeout and must not be mislabeled as a straggler
+                if straggler_armed and (
+                    device_deadline is None
+                    or shard_deadline <= device_deadline
+                ):
+                    SCAN_STATS.mesh_stragglers += 1
+                    SCAN_STATS.record_degradation(
+                        "mesh_straggler", scan_id=scan_id,
+                        deadline=e.deadline, mesh_size=n_dev, error=str(e),
+                    )
+                else:
+                    SCAN_STATS.record_degradation(
+                        "watchdog_timeout", scan_id=scan_id,
+                        deadline=e.deadline, error=str(e),
+                    )
+            # the degraded-mesh ladder comes BEFORE the whole-backend
+            # ladder: a fault attributable to specific mesh members costs
+            # those members, never the backend — the run continues on the
+            # largest healthy subset, and the CPU fallback is reached only
+            # when no accelerator subset remains
+            if not fallback and _reshard_after(e):
+                attempt += 1
+                continue
+            if not fallback:  # CPU-side faults are not accelerator health
+                DEVICE_HEALTH.record_fault(e)
+            # compile / lost / hang with no healthy subset left: retrying
+            # the same program on the same backend cannot help — fall
+            # back or raise typed
             if can_fallback and not fallback:
                 fallback = True
                 attempt += 1
@@ -1647,6 +1830,19 @@ def _run_scan_once(
     # device-resident fast path: table was persist()ed with a compatible
     # mesh — stream chunks straight from HBM, no packing, no transfer
     cache = getattr(table, "_device_cache", None)
+    if cache is not None and not cache.mesh_matches(mesh):
+        # a mesh change (degraded-mesh reshard, explicit use_mesh) strands
+        # the per-device shards on devices that may no longer be in the
+        # active mesh — stale residency must be FREED (and uncharged from
+        # the HBM budget), not just skipped, or a dead chip keeps its
+        # buffers and the budget gate overcommits the survivors
+        freed = _evict_device_cache(table)
+        SCAN_STATS.record_degradation(
+            "stale_residency_evicted",
+            scan_id=scan_ctx.get("scan_id"),
+            evicted_bytes=freed,
+        )
+        cache = None
     if cache is not None and not cache.matches(mesh, needed):
         cache = None
     if cache is not None and chunk_rows is not None and chunk_rows != cache.chunk:
@@ -2458,6 +2654,7 @@ def _run_scan_stream(
                 hook_ctx={
                     "scan_id": scan_id, "attempt": 0, "fallback": False,
                     "chunk_index": chunk_counter[0],
+                    "device_ids": mesh_device_ids(mesh),
                 },
             )
             chunk_counter[0] += 1

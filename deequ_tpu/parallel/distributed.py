@@ -1,4 +1,5 @@
-"""Multi-host initialization (the DCN story; SURVEY.md §2.15).
+"""Multi-host initialization + peer-loss handling (the DCN story;
+SURVEY.md §2.15).
 
 The engine itself is topology-agnostic: it runs over whatever mesh
 ``parallel.mesh.current_mesh()`` resolves. On a multi-host TPU slice, call
@@ -20,13 +21,27 @@ test_multihost_cross_process_state_merge: two real processes join via
 run the fused scan on their local meshes, exchange flat state vectors with
 an ``all_gather`` over the global cross-process mesh, and the folded
 metrics are asserted equal to a single-host full-table run.
+
+Peer loss: a host that dies mid-run stalls every cross-process collective.
+``check_peers`` converts that stall into a typed ``PeerLostException``
+(heartbeat + barrier timeout) — or, with ``on_peer_loss="degrade"``, into
+a ``PeerLossReport`` naming the surviving processes and the lost hosts'
+``host_row_range`` slices, which the caller completes WITHOUT and reports
+as ``unverified_row_ranges`` (partial results are reported, never silent).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
+
+from deequ_tpu.exceptions import PeerLostException
+
+#: default heartbeat/barrier timeout (seconds) before a peer is lost
+DEFAULT_PEER_TIMEOUT = 60.0
 
 
 def initialize_multi_host(
@@ -47,12 +62,198 @@ def initialize_multi_host(
     jax.distributed.initialize(**kwargs)
 
 
+def split_row_range(
+    total_rows: int, n_parts: int, part: int
+) -> Tuple[int, int]:
+    """Balanced [start, stop) split of ``total_rows`` into ``n_parts``:
+    the first ``total_rows % n_parts`` parts carry one extra row, so no
+    part ever differs from another by more than one row — the old
+    ceil-block split could hand trailing hosts ZERO rows (e.g. 10 rows /
+    8 processes gave hosts 0-4 two rows each and hosts 5-7 nothing) while
+    the early hosts carried the whole remainder."""
+    if n_parts <= 0:
+        raise ValueError(f"n_parts must be >= 1, got {n_parts}")
+    if not 0 <= part < n_parts:
+        raise ValueError(f"part must be in [0, {n_parts}), got {part}")
+    base, rem = divmod(max(int(total_rows), 0), n_parts)
+    start = part * base + min(part, rem)
+    stop = start + base + (1 if part < rem else 0)
+    return start, stop
+
+
 def host_row_range(total_rows: int) -> Tuple[int, int]:
     """The [start, stop) slice of a globally-ordered dataset this host
-    should ingest, balanced across processes."""
+    should ingest, balanced across processes (sizes differ by at most one
+    row; see ``split_row_range``)."""
+    return split_row_range(
+        total_rows, jax.process_count(), jax.process_index()
+    )
+
+
+# -- peer loss ---------------------------------------------------------------
+
+
+@dataclass
+class PeerLossReport:
+    """The outcome of one peer-health check.
+
+    ``lost`` names the process indices that stopped responding;
+    ``unverified_row_ranges`` are those hosts' ``host_row_range`` slices —
+    rows the degraded run completes WITHOUT, to be surfaced on
+    ``VerificationResult.unverified_row_ranges``."""
+
+    n_processes: int
+    surviving: List[int] = field(default_factory=list)
+    lost: List[int] = field(default_factory=list)
+    unverified_row_ranges: List[Tuple[int, int]] = field(
+        default_factory=list
+    )
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.lost)
+
+
+def _distributed_client():
+    """The process-wide jax.distributed client, or None outside a
+    multi-host run (structure probed defensively: the module is private
+    and has moved across jax releases)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — no client means single-host
+        return None
+
+
+# SPMD sequence for peer-probe barrier tags: every process runs the same
+# driver program, so the k-th check_peers call on each host agrees on tag
+# k — a DETERMINISTIC shared name. (Wall-clock tags cannot work: peers
+# crossing a second boundary, or any skew, would wait at different
+# barriers and declare each other lost.)
+_PEER_PROBE_SEQ = itertools.count()
+
+
+def _default_peer_probe(timeout: float) -> List[int]:
+    """Best-effort liveness probe over the jax.distributed key-value
+    store: this host publishes a heartbeat key, waits at a barrier, and —
+    when the barrier times out — reads which peers' heartbeat keys exist.
+    Returns the list of RESPONSIVE process indices (self always counts).
+    Raises TimeoutError when the runtime exposes no way to attribute the
+    stall (the caller then treats every peer as suspect).
+
+    Tag agreement relies on the SPMD convention: all processes make the
+    same sequence of check_peers calls, so the per-process counter yields
+    the same tag everywhere."""
+    client = _distributed_client()
     n_proc = jax.process_count()
     pid = jax.process_index()
-    per_host = (total_rows + n_proc - 1) // n_proc
-    start = min(pid * per_host, total_rows)
-    stop = min(start + per_host, total_rows)
-    return start, stop
+    if client is None or n_proc <= 1:
+        return list(range(n_proc))
+    tag = f"deequ_tpu_peers_{next(_PEER_PROBE_SEQ)}"
+    try:
+        client.key_value_set(f"{tag}/heartbeat/{pid}", "alive")
+    except Exception:  # noqa: BLE001 — store refused; fall through
+        pass
+    try:
+        client.wait_at_barrier(f"{tag}/barrier", int(timeout * 1000))
+        return list(range(n_proc))
+    except Exception:  # noqa: BLE001 — barrier timed out: attribute it
+        alive = [pid]
+        for peer in range(n_proc):
+            if peer == pid:
+                continue
+            try:
+                client.blocking_key_value_get(
+                    f"{tag}/heartbeat/{peer}", 1000
+                )
+                alive.append(peer)
+            except Exception:  # noqa: BLE001 — no heartbeat: peer is lost
+                continue
+        if len(alive) == n_proc:
+            # every peer heartbeated yet the barrier stalled — the stall
+            # is unattributable; let the caller decide
+            raise TimeoutError(
+                f"barrier timed out after {timeout:g}s with all "
+                f"{n_proc} heartbeats present"
+            )
+        return alive
+
+
+def check_peers(
+    total_rows: int,
+    timeout: float = DEFAULT_PEER_TIMEOUT,
+    on_peer_loss: str = "fail",
+    probe: Optional[Callable[[float], Sequence[int]]] = None,
+) -> PeerLossReport:
+    """Verify every peer process is still reachable; the multi-host
+    analogue of the single-host watchdog.
+
+    ``probe(timeout)`` returns the responsive process indices (default:
+    heartbeat + barrier over the jax.distributed key-value store; tests
+    inject a deterministic probe). On peer loss:
+
+    - ``on_peer_loss="fail"`` (default): raise a typed
+      ``PeerLostException`` naming the lost processes — the caller's cue
+      to abort before a collective hangs forever;
+    - ``on_peer_loss="degrade"``: return a ``PeerLossReport`` whose
+      ``unverified_row_ranges`` are the lost hosts' ``host_row_range``
+      slices; the surviving hosts complete the run over their own shards
+      and the omission is REPORTED (``ScanStats.record_unverified`` →
+      ``VerificationResult.unverified_row_ranges``), never silent.
+    """
+    if on_peer_loss not in ("fail", "degrade"):
+        raise ValueError(
+            f"on_peer_loss must be 'fail' or 'degrade', "
+            f"got {on_peer_loss!r}"
+        )
+    n_proc = jax.process_count()
+    report = PeerLossReport(n_processes=n_proc)
+    if n_proc <= 1:
+        report.surviving = list(range(n_proc))
+        return report
+    probe = probe or _default_peer_probe
+    try:
+        alive = sorted(int(p) for p in probe(timeout))
+    except TimeoutError as e:
+        # unattributable stall: degrading would silently drop unknown
+        # rows, so even "degrade" raises typed here
+        raise PeerLostException(
+            f"multi-host barrier timed out after {timeout:g}s and the "
+            f"stall could not be attributed to specific peers: {e}",
+        ) from e
+    lost = [p for p in range(n_proc) if p not in alive]
+    report.surviving = alive
+    report.lost = lost
+    if not lost:
+        return report
+    for peer in lost:
+        start, stop = split_row_range(total_rows, n_proc, peer)
+        if stop > start:
+            report.unverified_row_ranges.append((start, stop))
+    if on_peer_loss == "fail":
+        raise PeerLostException(
+            f"lost contact with process(es) {lost} after {timeout:g}s "
+            f"(surviving: {alive}); rerun, or pass "
+            f'on_peer_loss="degrade" to complete on the surviving hosts '
+            "with the lost hosts' row ranges reported unverified",
+            lost_processes=tuple(lost),
+        )
+    # degrade: the surviving hosts complete the run over their own
+    # shards; the lost rows are recorded as unverified on ScanStats so
+    # VerificationResult surfaces them
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    SCAN_STATS.peer_losses += len(lost)
+    for start, stop in report.unverified_row_ranges:
+        SCAN_STATS.record_unverified(
+            start, stop, reason=f"peer_lost:{','.join(map(str, lost))}"
+        )
+    if not report.unverified_row_ranges:
+        # a count-less source can't map the lost hosts to row ranges,
+        # but the loss itself must still be REPORTED, never silent
+        SCAN_STATS.record_degradation(
+            "peer_lost", lost_processes=sorted(lost),
+            reason="unverified row ranges unknown (no source row count)",
+        )
+    return report

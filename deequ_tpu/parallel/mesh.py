@@ -66,6 +66,30 @@ def set_mesh(mesh: Optional[Mesh]) -> None:
     _state.mesh = mesh
 
 
+def mesh_device_ids(mesh: Optional[Mesh]) -> tuple:
+    """The ``.id`` of every device on the mesh, in mesh order (empty for
+    the single-device/no-mesh case)."""
+    if mesh is None:
+        return ()
+    return tuple(int(d.id) for d in mesh.devices.flat)
+
+
+def mesh_excluding(mesh: Mesh, lost_ids) -> Optional[Mesh]:
+    """The largest healthy sub-mesh: ``mesh`` minus the devices whose ids
+    are in ``lost_ids``, preserving mesh order. Returns None when no
+    device survives (the caller's cue that only the CPU fallback
+    remains). A single survivor still gets a 1-device mesh — the scan
+    must stay pinned to the HEALTHY chip, not drift to the runtime's
+    default device (which may be the dead one)."""
+    import numpy as np
+
+    lost = {int(d) for d in lost_ids}
+    survivors = [d for d in mesh.devices.flat if int(d.id) not in lost]
+    if not survivors:
+        return None
+    return Mesh(np.array(survivors), tuple(mesh.axis_names))
+
+
 @contextlib.contextmanager
 def use_mesh(mesh: Optional[Mesh]):
     prev = getattr(_state, "mesh", "unset")

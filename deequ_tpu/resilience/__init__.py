@@ -33,8 +33,11 @@ from deequ_tpu.exceptions import (  # noqa: F401 — canonical home is exception
     DeviceHangException,
     DeviceLostException,
     DeviceOOMException,
+    MeshDegradedException,
+    PeerLostException,
     RetryExhaustedException,
     classify_device_error,
+    implicated_devices,
 )
 from deequ_tpu.resilience.atomic import (
     atomic_write_bytes,
@@ -78,7 +81,10 @@ __all__ = [
     "DeviceCompileException",
     "DeviceLostException",
     "DeviceHangException",
+    "MeshDegradedException",
+    "PeerLostException",
     "classify_device_error",
+    "implicated_devices",
     "RetryExhaustedException",
     "RETRY_TELEMETRY",
     "RetryTelemetry",

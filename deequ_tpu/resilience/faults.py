@@ -263,6 +263,25 @@ _DEVICE_FAULT_MESSAGES = {
     ),
 }
 
+# device-TARGETED variants: the message NAMES the chip (the shape real
+# per-chip XLA failures use), so exceptions.implicated_devices attributes
+# the fault and the degraded-mesh policy can shrink around it
+_TARGETED_FAULT_MESSAGES = {
+    "oom": (
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "{nbytes} bytes on device {device}. "
+        "[injected scan_id={scan_id} attempt={attempt}]"
+    ),
+    "compile": (
+        "INVALID_ARGUMENT: Compilation failure on device {device}: "
+        "injected lowering error [scan_id={scan_id} attempt={attempt}]"
+    ),
+    "lost": (
+        "UNAVAILABLE: injected device halt; device {device} is lost "
+        "[scan_id={scan_id} attempt={attempt}]"
+    ),
+}
+
 
 class FaultInjectingScanHook:
     """Seeded, scripted DEVICE faults at the scan engine's execute seam.
@@ -290,34 +309,51 @@ class FaultInjectingScanHook:
     ``hang_seconds`` inside the watchdog-wrapped call, so an armed
     ``device_deadline`` converts it into a ``DeviceHangException``.
 
+    Mesh targeting: a 3-tuple spec ``(kind, times, device)`` pins the
+    fault to ONE mesh member — it fires only while device id ``device``
+    is part of the scan's active mesh (``ctx["device_ids"]``), and the
+    injected message NAMES the chip exactly the way per-chip XLA failures
+    do, so the classifier attributes it and the degraded-mesh policy can
+    shrink around it. A permanently-dead chip
+    (``("lost", FaultSchedule.PERMANENT, 3)``) therefore stops faulting
+    the moment a reshard drops device 3 from the mesh — the scriptable
+    shape of a real chip loss.
+
     Relative scripting: ``faults`` keys are scan ids; pass
     ``relative=True`` to number scans from the first one THIS hook
     observes (so tests don't depend on how many scans ran before).
-    Every injection appends ``(kind, scan_id, attempt)`` to ``injected``
-    and every observation to ``calls`` — determinism is asserted by
-    comparing these logs across replays.
+    Every injection appends ``(kind, scan_id, attempt)`` — or
+    ``(kind, scan_id, attempt, device)`` for targeted faults — to
+    ``injected`` and every observation to ``calls`` — determinism is
+    asserted by comparing these logs across replays.
     """
 
     def __init__(
         self,
-        faults: Optional[Dict[int, Union[str, Tuple[str, float]]]] = None,
+        faults: Optional[Dict[int, Union[str, Tuple]]] = None,
         hang_seconds: float = 30.0,
         spare_fallback: bool = True,
         relative: bool = True,
     ):
-        self.faults: Dict[int, Tuple[str, float]] = {}
+        self.faults: Dict[int, Tuple[str, float, Optional[int]]] = {}
         for scan, spec in (faults or {}).items():
             if isinstance(spec, str):
                 spec = (spec, 1)
-            kind, times = spec
+            if len(spec) == 2:
+                kind, times = spec
+                device = None
+            else:
+                kind, times, device = spec
             if kind not in ("oom", "compile", "lost", "hang"):
                 raise ValueError(f"unknown device fault kind {kind!r}")
-            self.faults[int(scan)] = (kind, float(times))
+            self.faults[int(scan)] = (
+                kind, float(times), None if device is None else int(device),
+            )
         self.hang_seconds = float(hang_seconds)
         self.spare_fallback = bool(spare_fallback)
         self.relative = bool(relative)
         self._base_scan_id: Optional[int] = None
-        self.injected: List[Tuple[str, int, int]] = []
+        self.injected: List[Tuple] = []
         self.calls: List[Tuple[str, int, int, int]] = []
 
     def __call__(self, boundary: str, ctx: Dict) -> None:
@@ -335,9 +371,25 @@ class FaultInjectingScanHook:
         spec = self.faults.get(scan_id)
         if spec is None:
             return
-        kind, times = spec
+        kind, times, device = spec
         if attempt >= times:
             return
+        if device is not None:
+            # targeted fault: fires only while the chip is still a member
+            # of the active mesh — once a reshard drops it, its faults
+            # stop, like a real dead chip no one dispatches to anymore
+            if device not in (ctx.get("device_ids") or ()):
+                return
+            self.injected.append((kind, scan_id, attempt, device))
+            if kind == "hang":
+                time.sleep(self.hang_seconds)
+                return
+            raise InjectedDeviceError(
+                _TARGETED_FAULT_MESSAGES[kind].format(
+                    nbytes=8 << 30, scan_id=scan_id, attempt=attempt,
+                    device=device,
+                )
+            )
         self.injected.append((kind, scan_id, attempt))
         if kind == "hang":
             time.sleep(self.hang_seconds)

@@ -48,7 +48,22 @@ class VerificationResult:
       ``device_fetches`` exceeding ``scan_passes`` means per-chunk round
       trips somewhere (a non-device-foldable op, or
       DEEQU_TPU_DEVICE_FOLD=0); grouping passes add their own bounded
-      O(G) materializations."""
+      O(G) materializations.
+
+    Mesh faults get the same reported-never-silent treatment:
+
+    - ``mesh_events`` — the mesh-level degradation decisions of this run
+      (``mesh_reshard`` / ``mesh_quarantine`` / ``mesh_straggler`` /
+      ``stale_residency_evicted`` / ``peer_lost`` rows, a filtered view
+      of ``device_events``);
+    - ``resharded`` — True when any scan of this run completed on a
+      SHRUNKEN mesh after losing chip(s); the metrics are bit-identical
+      to a healthy run on that smaller mesh, but throughput was degraded;
+    - ``unverified_row_ranges`` — [start, stop) global row ranges a
+      degraded multi-host run (``on_peer_loss="degrade"``) completed
+      WITHOUT verifying: the lost hosts' shards. Non-empty means the
+      run's metrics cover a strict subset of the dataset — check statuses
+      hold only for the verified rows."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
@@ -58,6 +73,9 @@ class VerificationResult:
     fallback_backend: Optional[str] = None
     retry_stats: Dict[str, object] = field(default_factory=dict)
     scan_stats: Dict[str, object] = field(default_factory=dict)
+    mesh_events: List[dict] = field(default_factory=list)
+    resharded: bool = False
+    unverified_row_ranges: List[tuple] = field(default_factory=list)
 
     @staticmethod
     def success_metrics_as_rows(
@@ -94,6 +112,19 @@ class VerificationResult:
     @staticmethod
     def check_results_as_json(result: "VerificationResult") -> str:
         return json.dumps(VerificationResult.check_results_as_rows(result))
+
+
+#: degradation-event kinds that describe MESH-level decisions (surfaced
+#: separately on VerificationResult.mesh_events)
+_MESH_EVENT_KINDS = frozenset(
+    (
+        "mesh_reshard",
+        "mesh_quarantine",
+        "mesh_straggler",
+        "stale_residency_evicted",
+        "peer_lost",
+    )
+)
 
 
 def _dedup_analyzers(analyzers: Sequence[Analyzer]) -> List[Analyzer]:
@@ -154,6 +185,9 @@ class VerificationSuite:
         retry_policy=None,
         on_device_error: str = "fail",
         device_deadline: Optional[float] = None,
+        shard_deadline: Optional[float] = None,
+        on_peer_loss: Optional[str] = None,
+        peer_timeout: Optional[float] = None,
     ) -> VerificationResult:
         """Resilience knobs (streaming tables; deequ_tpu/resilience):
         ``checkpoint`` (StreamCheckpointer or directory path) makes the
@@ -168,7 +202,22 @@ class VerificationSuite:
         (seconds) arms the compute watchdog that converts a hung device
         call into a typed ``DeviceHangException``. Degradations taken are
         reported on ``result.device_events`` / ``result.fallback_backend``
-        and retry telemetry on ``result.retry_stats``."""
+        and retry telemetry on ``result.retry_stats``.
+
+        Mesh-fault knobs (multi-chip meshes): chip-attributable faults
+        always reshard onto the largest healthy device subset (the
+        reshard -> bisect -> CPU-fallback ladder; reported on
+        ``result.mesh_events`` / ``result.resharded``);
+        ``shard_deadline`` (seconds) arms the per-shard straggler
+        watchdog on mesh dispatches.
+
+        Multi-host knobs: ``on_peer_loss`` (None = no peer check) runs
+        ``parallel.distributed.check_peers`` INSIDE the run, before the
+        analysis — ``"fail"`` raises a typed ``PeerLostException`` when a
+        peer process stopped responding; ``"degrade"`` completes on the
+        surviving hosts and reports the lost hosts' row ranges on
+        ``result.unverified_row_ranges`` / ``result.mesh_events``.
+        ``peer_timeout`` overrides the heartbeat/barrier timeout."""
         from deequ_tpu.ops.scan_engine import SCAN_STATS
         from deequ_tpu.resilience.retry import RETRY_TELEMETRY
 
@@ -180,6 +229,7 @@ class VerificationSuite:
         retry_before = RETRY_TELEMETRY.snapshot()
         events_before = len(SCAN_STATS.degradation_events)
         fallback_before = SCAN_STATS.fallback_scans
+        unverified_before = len(SCAN_STATS.unverified_row_ranges)
         scan_before = {
             k: getattr(SCAN_STATS, k)
             for k in (
@@ -189,6 +239,32 @@ class VerificationSuite:
                 "drain_wait_seconds",
             )
         }
+
+        # the peer check runs INSIDE the run (after the telemetry baseline
+        # capture) so a degraded outcome lands on THIS result's
+        # unverified_row_ranges/mesh_events delta
+        if on_peer_loss is not None:
+            from deequ_tpu.parallel.distributed import (
+                DEFAULT_PEER_TIMEOUT,
+                check_peers,
+            )
+
+            # a count-less streaming source (StreamingTable.num_rows
+            # RAISES when the source doesn't know) still gets the peer
+            # check — the lost hosts just can't be mapped to row ranges
+            try:
+                total_rows = int(data.num_rows or 0)
+            except (AttributeError, TypeError):
+                total_rows = 0
+            check_peers(
+                total_rows,
+                timeout=(
+                    DEFAULT_PEER_TIMEOUT
+                    if peer_timeout is None
+                    else peer_timeout
+                ),
+                on_peer_loss=on_peer_loss,
+            )
 
         analysis_context = AnalysisRunner.do_analysis_run(
             data,
@@ -204,6 +280,7 @@ class VerificationSuite:
             retry_policy=retry_policy,
             on_device_error=on_device_error,
             device_deadline=device_deadline,
+            shard_deadline=shard_deadline,
         )
 
         # evaluate BEFORE appending the new result: anomaly constraints query
@@ -215,6 +292,20 @@ class VerificationSuite:
         # against the process-wide counters)
         result.device_events = [
             dict(e) for e in SCAN_STATS.degradation_events[events_before:]
+        ]
+        # mesh-level partial-result semantics: the mesh/peer rows of the
+        # event delta, whether any scan completed on a shrunken mesh, and
+        # the row ranges a degraded multi-host run left unverified
+        result.mesh_events = [
+            e for e in result.device_events
+            if e.get("kind") in _MESH_EVENT_KINDS
+        ]
+        result.resharded = any(
+            e.get("kind") == "mesh_reshard" for e in result.mesh_events
+        )
+        result.unverified_row_ranges = [
+            tuple(r)
+            for r in SCAN_STATS.unverified_row_ranges[unverified_before:]
         ]
         if SCAN_STATS.fallback_scans > fallback_before:
             result.fallback_backend = SCAN_STATS.fallback_backend
@@ -426,6 +517,9 @@ class VerificationRunBuilder:
         self._retry_policy = None
         self._on_device_error = "fail"
         self._device_deadline: Optional[float] = None
+        self._shard_deadline: Optional[float] = None
+        self._on_peer_loss: Optional[str] = None
+        self._peer_timeout: Optional[float] = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -527,6 +621,36 @@ class VerificationRunBuilder:
         self._device_deadline = float(seconds)
         return self
 
+    def with_shard_deadline(self, seconds: float) -> "VerificationRunBuilder":
+        """Arm the per-shard straggler watchdog on multi-chip mesh
+        dispatches: a chip stalling a collective past ``seconds`` raises
+        a typed ``DeviceHangException`` (recorded as a ``mesh_straggler``
+        event on ``result.mesh_events``) instead of freezing the whole
+        mesh. Single-device scans are unaffected. Also settable
+        process-wide via the ``DEEQU_TPU_SHARD_DEADLINE`` env var."""
+        self._shard_deadline = float(seconds)
+        return self
+
+    def on_peer_loss(
+        self, policy: str, timeout: Optional[float] = None
+    ) -> "VerificationRunBuilder":
+        """Multi-host peer-loss policy, checked INSIDE the run (no-op on
+        single-host): ``"fail"`` raises a typed ``PeerLostException``
+        when a peer process stopped responding (heartbeat + barrier over
+        jax.distributed); ``"degrade"`` completes the run on the
+        surviving hosts and reports the lost hosts' ``host_row_range``
+        slices on ``result.unverified_row_ranges`` — partial coverage is
+        reported, never silent. ``timeout`` (seconds) overrides the
+        probe's heartbeat/barrier deadline."""
+        if policy not in ("fail", "degrade"):
+            raise ValueError(
+                f"on_peer_loss must be 'fail' or 'degrade', got {policy!r}"
+            )
+        self._on_peer_loss = policy
+        if timeout is not None:
+            self._peer_timeout = float(timeout)
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -564,6 +688,9 @@ class VerificationRunBuilder:
             retry_policy=self._retry_policy,
             on_device_error=self._on_device_error,
             device_deadline=self._device_deadline,
+            shard_deadline=self._shard_deadline,
+            on_peer_loss=self._on_peer_loss,
+            peer_timeout=self._peer_timeout,
         )
 
 
