@@ -127,6 +127,91 @@ def measure_checkpoint_overhead(n_rows: int):
     }
 
 
+def measure_config3_selection(n_rows: int):
+    """Config-3 probe (the 25-correlations + 50-quantile-columns shape of
+    BASELINE config 3, scaled): the RESIDENT scan timed twice on the same
+    harness run — histogram selection kernel (default) vs the batched
+    device sort (DEEQU_TPU_SELECT_KERNEL=0) — so the recorded
+    ``select_vs_sort_speedup`` compares the two quantile kernels on
+    identical data, residency, and tunnel weather.
+
+    Contract asserts (bench REFUSES to report config 3 on violation,
+    like the one-fetch assert): the resident selection run must record
+    ZERO device sort passes and at least one selection pass; the A/B
+    sort run must record zero selection passes."""
+    import os
+
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    # ONE workload definition, shared with run_configs.config3 so the
+    # probe measures exactly the config it reports on
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
+    )
+    from run_configs import config3_workload, enforce_config3_contract
+
+    table, analyzers = config3_workload(n_rows)
+    try:
+        table.persist()
+    except MemoryError as e:
+        # selection only routes on the RESIDENT path; without residency
+        # there is nothing to contract-assert — skip the probe instead
+        # of sinking the whole bench run (run_configs.config3 handles
+        # the same case the same way)
+        print(f"config-3 selection probe skipped: {e}", file=sys.stderr)
+        return {
+            "config3_select_rows_per_sec": None,
+            "device_select_passes": None,
+            "device_sort_passes": None,
+            "sort_run_device_sort_passes": None,
+            "select_vs_sort_speedup": None,
+        }
+
+    def run(select: bool):
+        prev = os.environ.get("DEEQU_TPU_SELECT_KERNEL")
+        os.environ["DEEQU_TPU_SELECT_KERNEL"] = "1" if select else "0"
+        try:
+            SCAN_STATS.reset()
+            t0 = time.time()
+            ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+            wall = time.time() - t0
+        finally:
+            if prev is None:
+                os.environ.pop("DEEQU_TPU_SELECT_KERNEL", None)
+            else:
+                os.environ["DEEQU_TPU_SELECT_KERNEL"] = prev
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        return wall, SCAN_STATS.snapshot()
+
+    run(True)   # warmup/compile the selection program
+    run(False)  # warmup/compile the sort program
+    sel_wall, sel_snap = min(run(True), run(True), key=lambda r: r[0])
+    sort_wall, sort_snap = min(run(False), run(False), key=lambda r: r[0])
+
+    # the shared config-3 contract (one definition, run_configs.py;
+    # select_enabled=True: the run() wrapper pinned the kernel on for
+    # the selection reps); probe-local on top: the A/B sort run must
+    # not have selected
+    enforce_config3_contract(
+        sel_snap, table.is_persisted, select_enabled=True
+    )
+    assert sort_snap["device_select_passes"] == 0, (
+        "config-3 A/B violation: DEEQU_TPU_SELECT_KERNEL=0 still ran "
+        "the selection kernel"
+    )
+    # both canonical counters come from the SELECTION run (matching
+    # run_configs' emission semantics — zero sorts on a healthy resident
+    # path); the A/B run's sort count gets its own name
+    return {
+        "config3_select_rows_per_sec": round(n_rows / max(sel_wall, 1e-9), 1),
+        "device_select_passes": sel_snap["device_select_passes"],
+        "device_sort_passes": sel_snap["device_sort_passes"],
+        "sort_run_device_sort_passes": sort_snap["device_sort_passes"],
+        "select_vs_sort_speedup": round(sort_wall / max(sel_wall, 1e-9), 3),
+    }
+
+
 def measure_oom_bisection_overhead(n_rows: int):
     """Device-fault degradation cost probe: the same in-memory analysis
     timed clean vs with a seeded device OOM injected on its first attempt
@@ -357,7 +442,11 @@ def main():
     print(f"oom bisection probe: {oom_probe}", file=sys.stderr)
     reshard_probe = measure_reshard_overhead(SMOKE_ROWS if smoke else 200_000)
     print(f"reshard probe: {reshard_probe}", file=sys.stderr)
-    ckpt_probe = {**ckpt_probe, **oom_probe, **reshard_probe}
+    select_probe = measure_config3_selection(
+        SMOKE_ROWS if smoke else 200_000
+    )
+    print(f"config-3 selection probe: {select_probe}", file=sys.stderr)
+    ckpt_probe = {**ckpt_probe, **oom_probe, **reshard_probe, **select_probe}
 
     if smoke:
         print(

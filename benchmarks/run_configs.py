@@ -93,13 +93,14 @@ def config1():
     )
 
 
-def config3(n_rows: int):
-    """Correlation + ApproxQuantile(KLL) over 50 numeric columns."""
+def config3_workload(n_rows: int, n_cols: int = 50):
+    """(table, analyzers) for the config-3 shape — 25 correlations + 50
+    median columns over correlated normals. ONE definition shared by
+    ``config3`` below and bench.py's ``measure_config3_selection`` probe
+    so the probe can never drift from the reported config."""
     from deequ_tpu.analyzers import ApproxQuantile, Correlation
-    from deequ_tpu.analyzers.runner import AnalysisRunner
     from deequ_tpu.data.table import Column, ColumnarTable, DType
 
-    n_cols = 50
     rng = np.random.default_rng(42)
     base = rng.normal(0, 1, n_rows)
     cols = [
@@ -112,6 +113,52 @@ def config3(n_rows: int):
     table = ColumnarTable(cols)
     analyzers = [Correlation(f"c{2*i}", f"c{2*i+1}") for i in range(n_cols // 2)]
     analyzers += [ApproxQuantile(f"c{i}", 0.5) for i in range(n_cols)]
+    return table, analyzers
+
+
+def enforce_config3_contract(
+    snap: dict, resident: bool, select_enabled=None
+) -> bool:
+    """The PR-6 zero-sort contract, in ONE place for every config-3
+    harness (this module and bench.py's probe): on a resident run with
+    the selection kernel enabled and the default pair-plane layout, the
+    recorded ScanStats must show zero device sort passes and at least
+    one selection pass — otherwise the harness REFUSES to report config
+    3 (AssertionError), like PR 4's one-fetch assert. Returns True when
+    the contract bound (and held), False when it legitimately does not
+    apply (non-resident, kernel disabled, or DEEQU_TPU_COMPUTE=f64 —
+    wide-f64 columns have no u32 key plane, so the planner's sort
+    routing is correct there).
+
+    ``select_enabled``: the RESOLVED kernel switch of the run the
+    snapshot came from; pass it whenever the run pinned the kernel
+    programmatically (``run_scan(select_kernel=...)`` or a scoped env) —
+    defaulting to the ambient env here could silently skip the assert
+    for exactly the run it should bind on."""
+    from deequ_tpu.ops.scan_plan import select_kernel_enabled
+
+    if select_enabled is None:
+        select_enabled = select_kernel_enabled()
+    wide_forced = os.environ.get("DEEQU_TPU_COMPUTE", "").lower() == "f64"
+    if not (resident and select_enabled and not wide_forced):
+        return False
+    assert snap["device_sort_passes"] == 0, (
+        "config-3 contract violation: resident selection path ran "
+        f"{snap['device_sort_passes']} device sort passes — refusing "
+        "to report config 3"
+    )
+    assert snap["device_select_passes"] > 0, (
+        "config-3 contract violation: selection kernel never ran on the "
+        "resident path — refusing to report config 3"
+    )
+    return True
+
+
+def config3(n_rows: int):
+    """Correlation + ApproxQuantile(KLL) over 50 numeric columns."""
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+
+    table, analyzers = config3_workload(n_rows)
 
     # the timed quantity is the steady-state RESIDENT scan (persist is the
     # untimed df.cache() analogue): once resident, a same-table warmup is
@@ -134,10 +181,14 @@ def config3(n_rows: int):
     wall = time.time() - t0
     failed = [a for a, m in ctx.metric_map.items() if m.value.is_failure]
     assert not failed, failed[:3]
+    snap = SCAN_STATS.snapshot()
+    enforce_config3_contract(snap, table.is_persisted)
     return _emit(
         config=3, metric="corr_kll_50col_rows_per_sec", rows=n_rows,
         value=round(n_rows / wall, 1), unit="rows/sec",
         wall_seconds=round(wall, 3), resident=table.is_persisted,
+        device_sort_passes=snap["device_sort_passes"],
+        device_select_passes=snap["device_select_passes"],
         **_floor_telemetry(wall),
     )
 

@@ -393,6 +393,10 @@ def _kll_scan_op(
     (KLLRunner.scala:87-179)."""
     from deequ_tpu.analyzers.scan import _compile_where, _rows, _string_baked
     from deequ_tpu.ops.kll_device import chunk_summary
+    from deequ_tpu.ops.select_device import (
+        MAX_SELECT_SKETCH_SIZE,
+        chunk_summary_select,
+    )
 
     pred, wcols = _compile_where(where, table)
     cols = wcols | {column}
@@ -404,6 +408,27 @@ def _kll_scan_op(
         valid = rows & v.mask
         return chunk_summary(v.data, valid, sketch_size, n, xp, lo=v.lo)
 
+    def update_select(vals, row_valid, xp, n):
+        rows = _rows(vals, row_valid, xp, n, pred)
+        v = vals[col]
+        valid = rows & v.mask
+        if v.lo is None:
+            # planner/packer drift: the selection variant was routed to
+            # a column with no u32 key plane. Raising (trace time) beats
+            # silently sorting — a silent sort here would falsify the
+            # device_select/sort_passes census the config-3 contract
+            # asserts are built on. DEEQU_TPU_SELECT_KERNEL=0 is the
+            # mitigation while the routing bug is fixed.
+            raise ValueError(
+                f"selection kernel routed to wide-f64 column {col!r} "
+                "(no (hi, lo) key plane); planner/packer layout drift — "
+                "set DEEQU_TPU_SELECT_KERNEL=0 to fall back to the sort "
+                "path"
+            )
+        return chunk_summary_select(
+            v.data, valid, sketch_size, n, xp, lo=v.lo
+        )
+
     tags = {
         "items": "gather",
         "weights": "gather",
@@ -414,11 +439,18 @@ def _kll_scan_op(
     # where-free single-column KLL ops are coalescible into one batched
     # sort (see _kll_multi_scan_op / runner._coalesce_scan_ops)
     hint = ("kll", sketch_size, column) if where is None else None
+    # huge sketches (extreme relative_error requests) keep the sort
+    # path: the selection kernel's histograms are O(k*256) per column —
+    # an allocation chunk bisection cannot shrink (review catch)
+    selectable = sketch_size <= MAX_SELECT_SKETCH_SIZE
     return ScanOp(
         tuple(sorted(cols)), update, tags,
         dictionary_baked=_string_baked(table, wcols),
         batch_hint=hint,
         compact=_make_kll_compact(1, sketch_size),
+        select_update=update_select if selectable else None,
+        select_columns=(column,),
+        sorts_chunk=True,
     )
 
 
@@ -428,6 +460,10 @@ def _kll_multi_scan_op(columns: Tuple[str, ...], sketch_size: int) -> ScanOp:
     planner builds this from coalescible single-column ops; per-analyzer
     results are sliced back out by leading-axis stride (runner)."""
     from deequ_tpu.ops.kll_device import chunk_summary_batched
+    from deequ_tpu.ops.select_device import (
+        MAX_SELECT_SKETCH_SIZE,
+        chunk_summary_select_batched,
+    )
 
     def update(vals, row_valid, xp, n):
         X = xp.stack([vals[c].data for c in columns])
@@ -449,6 +485,23 @@ def _kll_multi_scan_op(columns: Tuple[str, ...], sketch_size: int) -> ScanOp:
             L = None
         return chunk_summary_batched(X, M, sketch_size, n, xp, lo=L)
 
+    def update_select(vals, row_valid, xp, n):
+        wide = [c for c in columns if vals[c].lo is None]
+        if wide:
+            # planner/packer drift (see the single-column variant): a
+            # silent sort here would falsify the kernel census the
+            # config-3 zero-sort contract asserts on — fail loudly
+            raise ValueError(
+                f"selection kernel routed to wide-f64 column(s) {wide!r} "
+                "(no (hi, lo) key plane); planner/packer layout drift — "
+                "set DEEQU_TPU_SELECT_KERNEL=0 to fall back to the sort "
+                "path"
+            )
+        X = xp.stack([vals[c].data for c in columns])
+        M = xp.stack([vals[c].mask & row_valid for c in columns])
+        L = xp.stack([vals[c].lo for c in columns])
+        return chunk_summary_select_batched(X, M, sketch_size, n, xp, lo=L)
+
     tags = {
         "items": "gather",
         "weights": "gather",
@@ -456,9 +509,15 @@ def _kll_multi_scan_op(columns: Tuple[str, ...], sketch_size: int) -> ScanOp:
         "min": "min",
         "max": "max",
     }
+    # same huge-sketch gate as the single-column op: the batched
+    # selection histograms scale O(k*256) per MEMBER column
+    selectable = sketch_size <= MAX_SELECT_SKETCH_SIZE
     return ScanOp(
         tuple(sorted(columns)), update, tags,
         compact=_make_kll_compact(len(columns), sketch_size),
+        select_update=update_select if selectable else None,
+        select_columns=tuple(columns),
+        sorts_chunk=True,
     )
 
 
@@ -573,6 +632,55 @@ def _sketch_size_for_error(relative_error: float) -> int:
     return max(256, int(2.3 / max(relative_error, 1e-6)))
 
 
+def _validate_quantile_type(q) -> None:
+    """Construction-time validation for the failure class that would
+    otherwise surface as an OPAQUE trace error inside the fused kernel:
+    q must be a real number and not NaN. The RANGE check lives in
+    preconditions (``_validate_quantile_range``) so persisted results /
+    deequ imports written under the historic closed-interval rule still
+    deserialize — they fail their run with a typed metric instead of
+    making the whole repository unloadable."""
+    import numbers
+
+    if not isinstance(q, numbers.Real) or isinstance(q, bool):
+        raise IllegalAnalyzerParameterException(
+            f"Quantile parameter must be a number, got {q!r}"
+        )
+    if math.isnan(float(q)):
+        raise IllegalAnalyzerParameterException(
+            "Quantile parameter must not be NaN"
+        )
+
+
+def _validate_quantile_range(q) -> None:
+    """Typed up-front (precondition) validation: q strictly inside
+    (0, 1) — q = 0/1 name endpoints no rank of a finite sample maps to
+    one-to-one; checked before any kernel work, so the violation is a
+    typed per-analyzer failure, never a crash inside the scan."""
+    _validate_quantile_type(q)
+    if not (0.0 < float(q) < 1.0):
+        raise IllegalAnalyzerParameterException(
+            "Quantile parameter must be in the open interval (0, 1), "
+            f"got {q!r}"
+        )
+
+
+def _validate_quantiles(qs) -> Tuple[float, ...]:
+    """ApproxQuantiles argument hygiene at construction: every q
+    type-checked, duplicates removed (first occurrence wins, order
+    preserved — the metric is keyed by str(q), so duplicates could only
+    overwrite themselves with the same value). Emptiness and range are
+    precondition failures, not construction errors (see
+    ``_validate_quantile_type`` on why)."""
+    qs = tuple(qs)
+    seen = []
+    for q in qs:
+        _validate_quantile_type(q)
+        if q not in seen:
+            seen.append(q)
+    return tuple(seen)
+
+
 @dataclass(frozen=True)
 class ApproxQuantile(ScanShareableAnalyzer):
     """Single approximate quantile (reference analyzers/ApproxQuantile.scala).
@@ -587,16 +695,20 @@ class ApproxQuantile(ScanShareableAnalyzer):
     relative_error: float = 0.01
     where: Optional[str] = None
 
+    def __post_init__(self):
+        # the would-crash-the-trace class (non-numeric, NaN) is rejected
+        # at CONSTRUCTION; the (0, 1) range rule is a precondition so
+        # persisted analyzers from the historic closed-interval era
+        # still deserialize (and fail typed at run time)
+        _validate_quantile_type(self.quantile)
+
     @property
     def instance(self) -> str:
         return self.column
 
     def preconditions(self):
         def param_check(schema):
-            if not (0.0 <= self.quantile <= 1.0):
-                raise IllegalAnalyzerParameterException(
-                    "Quantile parameter must be in the closed interval [0, 1]"
-                )
+            _validate_quantile_range(self.quantile)
             if not (0.0 <= self.relative_error <= 1.0):
                 raise IllegalAnalyzerParameterException(
                     "Relative error parameter must be in the closed interval [0, 1]"
@@ -642,7 +754,10 @@ class ApproxQuantiles(ScanShareableAnalyzer):
 
     def __init__(self, column, quantiles, relative_error=0.01):
         object.__setattr__(self, "column", column)
-        object.__setattr__(self, "quantiles", tuple(quantiles))
+        # type-check + dedup (order-preserving) at construction: the
+        # deduped tuple is the identity, so equal analyzer specs stay
+        # equal metric_map keys; range/emptiness are preconditions
+        object.__setattr__(self, "quantiles", _validate_quantiles(quantiles))
         object.__setattr__(self, "relative_error", relative_error)
 
     @property
@@ -651,11 +766,12 @@ class ApproxQuantiles(ScanShareableAnalyzer):
 
     def preconditions(self):
         def param_check(schema):
+            if not self.quantiles:
+                raise IllegalAnalyzerParameterException(
+                    "Quantiles parameter must be a non-empty sequence"
+                )
             for q in self.quantiles:
-                if not (0.0 <= q <= 1.0):
-                    raise IllegalAnalyzerParameterException(
-                        "Quantile parameter must be in the closed interval [0, 1]"
-                    )
+                _validate_quantile_range(q)
             if not (0.0 <= self.relative_error <= 1.0):
                 raise IllegalAnalyzerParameterException(
                     "Relative error parameter must be in the closed interval [0, 1]"

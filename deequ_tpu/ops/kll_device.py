@@ -42,6 +42,25 @@ def strata_capacity(local_n: int, sketch_size: int) -> int:
     return 1 << max(math.ceil(math.log2(ratio)), 0)
 
 
+def strata_weight(m, k: int, xp):
+    """(w, n_strata) for m valid rows and sketch size k — the stratum
+    weight w = 2^L with L = ceil(log2(ceil(m/k))): the smallest power of
+    two reducing m items to <= k strata. Computed with an INTEGER shift:
+    XLA's float exp2/log2 are not exact at integer points (CPU x64
+    exp2(3.0) = 7.999999999999998, truncating to w=7 — which silently
+    dropped ~10% of rows on the single-device path until the mesh/no-mesh
+    test matrix caught it). The epsilon guards log2 landing just above an
+    integer; the where() doubles w if it still came out one step short,
+    making w exact regardless of libm rounding. Shared by the sort-path
+    summary (``chunk_summary``) and the selection kernel
+    (ops/select_device.py) so their strata layouts can never drift."""
+    ratio = xp.maximum((m + k - 1) // k, 1)
+    log2r = xp.ceil(xp.log2(ratio.astype(xp.float64)) - 1e-9)
+    w = xp.left_shift(xp.asarray(1, dtype=m.dtype), log2r.astype(m.dtype))
+    w = xp.where(w * k < m, w * 2, w)
+    return w, m // w
+
+
 def chunk_summary(x, valid, sketch_size: int, local_n: int, xp, lo=None):
     """Inside-jit: one chunk/shard -> fixed-shape weighted summary.
 
@@ -82,22 +101,7 @@ def chunk_summary(x, valid, sketch_size: int, local_n: int, xp, lo=None):
         mx = xp.max(xp.where(valid, x.astype(xp.float64), -xp.inf))
 
     m = valid.sum()
-
-    # weight w = 2^L with L = ceil(log2(ceil(m/k))): the smallest power of
-    # two reducing m items to <= k strata. Computed with an INTEGER shift:
-    # XLA's float exp2/log2 are not exact at integer points (CPU x64
-    # exp2(3.0) = 7.999999999999998, truncating to w=7 — which silently
-    # dropped ~10% of rows on the single-device path until the mesh/no-mesh
-    # test matrix caught it). The epsilon guards log2 landing just above an
-    # integer; the where() doubles w if it still came out one step short,
-    # making w exact regardless of libm rounding.
-    ratio = xp.maximum((m + k - 1) // k, 1)
-    log2r = xp.ceil(xp.log2(ratio.astype(xp.float64)) - 1e-9)
-    w = xp.left_shift(
-        xp.asarray(1, dtype=m.dtype), log2r.astype(m.dtype)
-    )
-    w = xp.where(w * k < m, w * 2, w)
-    n_strata = m // w
+    w, n_strata = strata_weight(m, k, xp)
 
     # strata midpoints: item i represents rows [i*w, (i+1)*w)
     sidx = xp.arange(k) * w + w // 2

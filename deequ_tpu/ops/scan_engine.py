@@ -207,6 +207,17 @@ class ScanOp:
     # items). Keeps host memory O(1) in chunk count on TB-scale streams.
     compact: Optional[Callable[[Any], Any]] = None
     compact_threshold: int = 1 << 20
+    # kernel-variant seam (ops/scan_plan.py): an alternative update fn
+    # computing the SAME partial state via the batched histogram
+    # selection kernel (ops/select_device.py) instead of a device sort.
+    # The planner swaps it in per scan ATTEMPT when the table is
+    # resident and select_columns all ride (hi, lo) key planes; the
+    # fault ladder never sees the substitution.
+    select_update: Optional[Callable[[Dict[str, Val], Any, Any, int], Any]] = None
+    select_columns: Tuple[str, ...] = ()
+    # True when `update` runs a full device sort per chunk (the KLL
+    # summary kernels) — the census behind ScanStats.device_sort_passes
+    sorts_chunk: bool = False
 
 
 class ScanStats:
@@ -232,6 +243,11 @@ class ScanStats:
         self.programs_built = 0
         self.programs_reused = 0
         self.device_sort_passes = 0
+        # per-chunk KLL/quantile summary kernels that ran the histogram
+        # SELECTION kernel instead of a sort (ops/select_device.py): on
+        # the resident selection path device_sort_passes stays 0 and
+        # this counts what replaced it — the config-3 contract pair
+        self.device_select_passes = 0
         # device->host result bytes (grouping paths): the sparse group-by
         # contract is fetched bytes ~ O(k*G), never O(k*n)
         self.bytes_fetched = 0
@@ -1434,6 +1450,16 @@ MIN_BISECT_CHUNK_ROWS = 64
 _SCAN_IDS = itertools.count()
 
 
+def _record_kernel_passes(plan_ir, chunks: int) -> None:
+    """Account the per-chunk KLL/quantile kernel census of one or more
+    chunk dispatches (ops/scan_plan.py): how many ran a device sort vs
+    the histogram selection kernel — the observable behind the config-3
+    zero-sort contract."""
+    if chunks:
+        SCAN_STATS.device_sort_passes += plan_ir.sort_ops * chunks
+        SCAN_STATS.device_select_passes += plan_ir.select_ops * chunks
+
+
 def _block_throttle(arr) -> None:
     """Wait for a device result WITHOUT fetching it (pipeline
     backpressure for the device-fold loops). The wait is a drain in the
@@ -1494,6 +1520,7 @@ def run_scan(
     device_deadline: Optional[float] = None,
     window: Optional[int] = None,
     shard_deadline: Optional[float] = None,
+    select_kernel: Optional[bool] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1551,15 +1578,27 @@ def run_scan(
       dispatches: a chip stalling a collective past it raises a typed
       ``DeviceHangException`` recorded as a ``mesh_straggler`` event.
 
+    ``select_kernel`` (default: the DEEQU_TPU_SELECT_KERNEL env var,
+    default on) routes resident KLL/quantile summary ops through the
+    batched histogram selection kernel instead of the device sort
+    (ops/scan_plan.py decides per attempt; ops/select_device.py is the
+    kernel). ``select_kernel=False`` / DEEQU_TPU_SELECT_KERNEL=0 keeps
+    the sort path everywhere — the A/B + regression-triage escape hatch.
+
     ``defer=True`` scans dispatch under the same typed boundaries, but
     errors surfacing at ``result()`` are past bisection/fallback — the
     caller holds the only retry point then.
     """
+    from deequ_tpu.ops.scan_plan import select_kernel_enabled
+
     if on_device_error not in ("fail", "fallback"):
         raise ValueError(
             f"on_device_error must be 'fail' or 'fallback', "
             f"got {on_device_error!r}"
         )
+    # resolve (and validate) the selection-kernel switch ONCE per run so
+    # every bisection/reshard attempt plans against the same setting
+    select_kernel = select_kernel_enabled(select_kernel)
     if mesh is None:
         mesh = current_mesh()
     if device_deadline is None:
@@ -1590,7 +1629,7 @@ def run_scan(
         return _run_scan_stream(
             table, ops, chunk_rows, mesh,
             scan_id=scan_id, device_deadline=stream_deadline,
-            window=window,
+            window=window, select_kernel=select_kernel,
         )
 
     chunk_override = chunk_rows
@@ -1711,10 +1750,12 @@ def run_scan(
                     return _run_scan_once(
                         table, ops, chunk_override, None, defer,
                         None, scan_ctx, report, window,
+                        select_kernel=select_kernel,
                     )
             result = _run_scan_once(
                 table, ops, chunk_override, mesh, defer,
                 attempt_deadline, scan_ctx, report, window,
+                select_kernel=select_kernel,
             )
             DEVICE_HEALTH.record_success()
             if n_dev > 1:
@@ -1816,11 +1857,13 @@ def _run_scan_once(
     scan_ctx: Dict[str, Any],
     report: Dict[str, Any],
     window: int = DEFAULT_SCAN_WINDOW,
+    select_kernel: bool = True,
 ) -> List[Any]:
     """One attempt of the fused in-memory scan (the pre-fault-tolerance
     run_scan body, instrumented at the three device boundaries).
     ``report`` returns the chunk size actually used so the bisection
     driver can halve it."""
+    from deequ_tpu.ops.scan_plan import plan_scan_ops
     n_rows = table.num_rows
     needed = sorted({c for op in ops for c in op.columns})
     cols = {name: table[name] for name in needed}
@@ -1861,6 +1904,15 @@ def _run_scan_once(
         packer = _ChunkPacker(cols, chunk)
     report["chunk"] = chunk
     local_n = chunk // n_dev if mesh is not None else chunk
+
+    # kernel-variant resolution for THIS attempt (ops/scan_plan.py):
+    # resident tables route KLL/quantile summaries through the histogram
+    # selection kernel; re-planned per attempt, so an OOM retry that
+    # evicted residency falls back to the sort path by construction
+    plan_ir = plan_scan_ops(
+        ops, packer, resident=cache is not None, select_kernel=select_kernel
+    )
+    ops = plan_ir.ops
 
     # dictionary LUTs ship once (memoized device arrays) and enter the
     # jitted step as arguments
@@ -2024,6 +2076,7 @@ def _run_scan_once(
                 hook_ctx={**scan_ctx, "chunk_index": 0},
             )
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
+            _record_kernel_passes(plan_ir, n_chunks)
             folded = n_chunks
         else:
             for ci, args in enumerate(cache.device_chunks):
@@ -2036,6 +2089,7 @@ def _run_scan_once(
                     hook_ctx={**scan_ctx, "chunk_index": ci},
                 )
                 SCAN_STATS.dispatch_seconds += _time.time() - t_d
+                _record_kernel_passes(plan_ir, 1)
                 if use_fold:
                     fold_chunk(flat, ci)
                     # same backpressure as the packing loop: queued
@@ -2084,6 +2138,7 @@ def _run_scan_once(
                 hook_ctx={**scan_ctx, "chunk_index": ci},
             )
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
+            _record_kernel_passes(plan_ir, 1)
             if use_fold:
                 fold_chunk(flat, ci)
                 # throttle, don't drain: block on (not fetch) the oldest
@@ -2364,6 +2419,13 @@ def run_scan_group(
     t_d = _time.time()
     device_out = vstep(*bufs, lut_stacked)
     SCAN_STATS.dispatch_seconds += _time.time() - t_d
+    # grouped micro-batches are packed fresh per call (never resident):
+    # the kernel census is the sort path's, once per table in the stack
+    from deequ_tpu.ops.scan_plan import plan_scan_ops
+
+    _record_kernel_passes(
+        plan_scan_ops(ops, None, resident=False), K
+    )
 
     folders = []
     for _ in range(K):
@@ -2493,6 +2555,7 @@ def _run_scan_stream(
     scan_id: int = -1,
     device_deadline: Optional[float] = None,
     window: int = DEFAULT_SCAN_WINDOW,
+    select_kernel: bool = True,
 ) -> List[Any]:
     """One fused pass over a StreamingTable: batches stream off storage on
     a reader thread, pack into fixed-size chunks, and dispatch with a small
@@ -2511,6 +2574,15 @@ def _run_scan_stream(
     through the runner's resilient loop (``on_device_error`` /
     ``on_batch_error`` / ``checkpoint``), which scans each batch as an
     in-memory table under the full policy."""
+    from deequ_tpu.ops.scan_plan import plan_scan_ops
+
+    # streaming chunks are never resident: the planner keeps the sort
+    # path (selection only fires on resident attempts) but still supplies
+    # the per-chunk kernel census for ScanStats
+    plan_ir = plan_scan_ops(
+        ops, None, resident=False, select_kernel=select_kernel
+    )
+    ops = plan_ir.ops
     needed = sorted({c for op in ops for c in op.columns})
     schema = stream.schema
     dtypes = {n: schema[n].dtype for n in needed}
@@ -2659,6 +2731,7 @@ def _run_scan_stream(
             )
             chunk_counter[0] += 1
             SCAN_STATS.dispatch_seconds += _time.time() - t_d
+            _record_kernel_passes(plan_ir, 1)
             if use_fold:
                 if fold_state["plan"] is None:
                     fold_state["plan"] = _fold_plan_for(

@@ -1,0 +1,127 @@
+"""ScanPlan: kernel-variant resolution for the fused scan — the first
+slice of the plan/executor/policy split (ROADMAP item 5).
+
+``run_scan``'s fault ladder (reshard -> bisect -> CPU fallback) retries
+``_run_scan_once`` with changed *resources* (smaller chunks, a smaller
+mesh, evicted residency, another backend). Kernel choices that depend on
+those resources must therefore be (re-)derived INSIDE each attempt, from
+the attempt's own packer/residency state — never threaded through the
+ladder as sticky state. ``plan_scan_ops`` is that derivation point: it
+takes the ops as the analyzers built them and returns the concrete ops
+the executor will trace, with per-op kernel variants resolved.
+
+Today the planner makes one decision: route KLL/quantile summary ops
+through the batched histogram SELECTION kernel (ops/select_device.py)
+instead of the full device sort (ops/kll_device.py) when
+
+  - the op offers a selection variant (``ScanOp.select_update``),
+  - the kernel is enabled (``run_scan(select_kernel=...)`` /
+    ``DEEQU_TPU_SELECT_KERNEL``, default on),
+  - the table is RESIDENT (persisted in HBM): the selection kernel's
+    win is redesigning the memory path of multi-pass rank queries over
+    data already sitting in HBM; streaming/non-resident chunks keep the
+    sort path (same summaries either way — the two kernels are
+    exact-rank interchangeable, docs/numerics.md), and
+  - every column the kernel selects over rides a two-float/i32 plane in
+    the packer layout (wide-f64 columns have no u32 key domain).
+
+Because an OOM retry evicts residency before re-planning, a fault during
+a selection pass lands the next attempt on the sort path automatically —
+the ladder needs no knowledge of kernel variants at all.
+
+The resolved plan also carries the per-chunk kernel census
+(``sort_ops``/``select_ops``) that the executor turns into
+``ScanStats.device_sort_passes`` / ``device_select_passes``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence, Tuple
+
+
+def select_kernel_enabled(param: Optional[bool] = None) -> bool:
+    """Resolve the selection-kernel switch: explicit argument wins, then
+    the DEEQU_TPU_SELECT_KERNEL env var ('0' disables — the A/B and
+    regression-triage escape hatch, mirroring DEEQU_TPU_FUSED_RESIDENT),
+    then on. Validated: the argument must be bool-like, the env var one
+    of '', '0', '1'."""
+    if param is not None:
+        if not isinstance(param, (bool, int)) or param not in (0, 1):
+            raise ValueError(
+                f"select_kernel must be True/False, got {param!r}"
+            )
+        return bool(param)
+    raw = os.environ.get("DEEQU_TPU_SELECT_KERNEL", "").strip()
+    if raw not in ("", "0", "1"):
+        raise ValueError(
+            f"DEEQU_TPU_SELECT_KERNEL must be '0' or '1', got {raw!r}"
+        )
+    return raw != "0"
+
+
+@dataclass(frozen=True)
+class ScanPlan:
+    """One attempt's resolved op list + kernel census.
+
+    ``ops`` are the concrete ScanOps the executor traces (variant
+    substitutions applied, cache keys rewritten so traced-program caches
+    can never serve a sort-path program to a selection-path scan or vice
+    versa). ``sort_ops``/``select_ops`` count ops per chunk dispatch that
+    run a device sort / a histogram selection — the executor multiplies
+    by chunks processed into ScanStats."""
+
+    ops: Tuple
+    resident: bool
+    select_ops: int = 0
+    sort_ops: int = 0
+
+
+def _selectable(op, packer) -> bool:
+    """True when every column the op's selection kernel keys on rides a
+    (hi, lo) plane in this packer layout: two-float pairs, i32-split
+    integrals, or hi-only (lossy f32) — anything but the wide-f64 plane,
+    whose 64-bit keys the u32 radix passes cannot cover."""
+    if packer is None:
+        return False
+    keyed = set(packer.pair_names) | set(packer.narrow_i32) | set(
+        packer.hi_only_names
+    )
+    return all(c in keyed for c in op.select_columns)
+
+
+def plan_scan_ops(
+    ops: Sequence,
+    packer=None,
+    resident: bool = False,
+    select_kernel: Optional[bool] = None,
+) -> ScanPlan:
+    """Resolve kernel variants for one scan attempt (see module doc)."""
+    use_select = resident and select_kernel_enabled(select_kernel)
+    resolved = []
+    n_select = 0
+    n_sort = 0
+    for op in ops:
+        if op.select_update is not None and use_select and _selectable(
+            op, packer
+        ):
+            key = (
+                ("select", op.cache_key)
+                if op.cache_key is not None
+                else None
+            )
+            resolved.append(
+                replace(op, update=op.select_update, cache_key=key)
+            )
+            n_select += 1
+        else:
+            resolved.append(op)
+            if op.sorts_chunk:
+                n_sort += 1
+    return ScanPlan(
+        ops=tuple(resolved),
+        resident=resident,
+        select_ops=n_select,
+        sort_ops=n_sort,
+    )

@@ -1,0 +1,335 @@
+"""Batched multi-rank SELECTION for device quantiles: iterative histogram
+range-narrowing instead of a full sort.
+
+``ops/kll_device.chunk_summary_batched`` pins each KLL stratum boundary by
+sorting the whole chunk (one vmapped XLA sort per pass — ~9s for 50x4M f32
+on the bench chip, the only workload where the engine loses on *compute*
+rather than tunnel latency, BENCHMARKS.md config 3). But the summary only
+ever READS k+W rank positions out of the sorted array; a comparison sort
+computes n*log(n) order information to answer k+W rank queries. CPU
+engines answer the same queries with introselect in O(n); the accelerator
+equivalent built here is a *batched multi-rank radix selection*:
+
+  1. Map the f32 hi plane to its order-preserving u32 key (one bitcast +
+     bit-twiddle). The key order equals the sort path's order: -inf <
+     ... < -0.0 < +0.0 < ... < +inf < NaN, with every NaN (either sign)
+     keyed 0xFFFFFFFF because jnp.sort follows numpy semantics and
+     places all NaNs last. Invalid rows take the +inf key itself — the
+     sort path pads them with literal +inf, so they join the same tie
+     group and ranks resolve to identical values.
+  2. Narrow every target rank simultaneously with THREE histogram
+     passes over the 16+8+8-bit radix digits. Each pass is one fused
+     ``segment_sum``/bincount dispatch covering all columns and all
+     targets at once: an element's segment row comes from a dense
+     prefix->row lookup table (scattered from the <= R active target
+     prefixes — no sorted structure of the DATA ever exists), its
+     bucket from its own next radix digit; each target then walks the
+     cumulative counts of its row to pick the bucket holding its rank,
+     narrowing its [lo, hi) key range by the digit width. After the
+     third pass every stratum midpoint and quantile rank is pinned to
+     the exact 32-bit key at that rank.
+  3. Reconstruct the f64 item per target: the selected f32 hi value
+     plus a deterministically-chosen lo-plane rider (tie rule below),
+     and extract the < w exact-remainder elements by threshold +
+     stable tie-split + scatter compaction.
+
+Passes touch each element O(1) times (shift/gather/scatter-add in native
+u32/i32 ops — no f64 emulation, no u64: the tunnel compiler rejects
+64-bit bitcasts, ops/hll.py). The output contract is IDENTICAL to
+``kll_device.chunk_summary``: the same {items, weights, count, min, max}
+summary with the same strata/remainder layout, so ``fold_summaries`` and
+the whole KLL merge algebra (host sketches, persisted states, incremental
+merges) are untouched.
+
+Determinism and parity with the sort path (docs/numerics.md, "selection
+kernel determinism"):
+
+- the selected hi-plane VALUE at every rank is exactly the sort path's
+  (both resolve the same total order on f32);
+- the lo-plane rider for a stratum midpoint is the lo of the
+  minimum-index element among the hi-plane ties. Exact duplicates (equal
+  f64 values) carry equal lo, so the item is bit-identical to the sort
+  path's; only *distinct* f64 values colliding on the same f32 hi (< 1
+  ulp(f32) apart, ~6e-8 relative) can differ — inside the tie-order
+  ambiguity the sort path already documents for itself;
+- the remainder multiset reproduces the stable-argsort tie split
+  exactly: ties at the threshold key enter the remainder in original
+  index order, so remainder contents match the sort path element for
+  element (the summary is order-insensitive; ``fold_summaries`` sorts
+  per level).
+
+jnp-only: the histogram passes are scatter/gather programs with no numpy
+mirror here — the host reference for tests is the sort path itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deequ_tpu.ops.kll_device import strata_capacity, strata_weight
+
+# radix digit plan: 16 bits in the first pass (a plain bincount — every
+# target still shares the single full-range interval), then 8+8 with
+# dense prefix->row LUTs (2^16 and (R*256)-entry tables). Three passes
+# pin all 32 key bits.
+_PASS1_BITS = 16
+_PASS_BITS = 8
+_B = 1 << _PASS_BITS
+
+# largest sketch size the selection kernel accepts: the pass-2/3
+# histograms and LUTs are O(k * 256) i32 PER COLUMN (~17MB at this cap,
+# x50 coalesced columns under vmap) — buffers chunk bisection cannot
+# shrink, unlike the sort path whose footprint is O(n). Ops above the
+# cap keep the sort path (the analyzers attach no selection variant).
+# Default sketches sit far below (KLLSketch k=2048, ApproxQuantile's
+# default relative_error=0.01 gives k=256); only extreme precision
+# requests (relative_error below ~1.4e-4, i.e. k = 2.3/eps > 16384)
+# exceed it and simply stay on the sort kernel.
+MAX_SELECT_SKETCH_SIZE = 1 << 14
+
+
+def monotone_u32(x, xp):
+    """Order-preserving f32 -> u32 key: sign bit flipped for positives,
+    all bits flipped for negatives — u32 `<` then agrees with the float
+    order the sort path resolves (including -0.0 < +0.0), with ONE
+    deliberate adjustment: every NaN (either sign bit) maps to
+    0xFFFFFFFF, above +inf. ``jnp.sort``/``argsort`` follow numpy
+    semantics and place ALL NaNs last regardless of sign; the plain
+    sign-flip bijection would put -NaN *below* -inf and shift every rank
+    between the two kernels (caught in review by a valid negative-NaN
+    column)."""
+    import jax
+
+    bits = jax.lax.bitcast_convert_type(x, xp.uint32)
+    neg = (bits >> xp.uint32(31)).astype(xp.bool_)
+    key = xp.where(neg, ~bits, bits | xp.uint32(0x80000000))
+    return xp.where(xp.isnan(x), xp.uint32(0xFFFFFFFF), key)
+
+
+def inverse_monotone_u32(u, xp):
+    """Inverse of ``monotone_u32``."""
+    import jax
+
+    u = u.astype(xp.uint32)
+    pos = (u >> xp.uint32(31)).astype(xp.bool_)
+    bits = xp.where(pos, u ^ xp.uint32(0x80000000), ~u)
+    return jax.lax.bitcast_convert_type(bits, xp.float32)
+
+
+def _segment_count(seg, num_segments: int, xp):
+    """Histogram of i32 segment ids (one scatter-add dispatch;
+    ``at[].add`` rather than segment_sum — same scatter, but without
+    materializing the all-ones operand, measured ~2x faster on CPU)."""
+    return xp.zeros((num_segments,), dtype=xp.int32).at[seg].add(1)
+
+
+def _bucket_of_rank(tcum, rank_rem, xp):
+    """Per target: the first bucket whose cumulative count exceeds the
+    target's rank-within-interval, and the count below that bucket.
+    ``tcum`` is (R, B) cumulative counts; B is small, so a compare-reduce
+    beats a batched binary search."""
+    bucket = xp.sum((tcum <= rank_rem[:, None]).astype(xp.int32), axis=1)
+    bucket = xp.minimum(bucket, tcum.shape[1] - 1)
+    below = xp.take_along_axis(
+        tcum, xp.maximum(bucket - 1, 0)[:, None], axis=1
+    )[:, 0]
+    below = xp.where(bucket > 0, below, 0).astype(xp.int32)
+    return bucket, below
+
+
+def _select_u32_multirank(u, ranks, xp):
+    """Resolve ``ranks`` (R target rank positions, i32, each in [0, n))
+    against the ascending order of ``u`` ((n,) u32 keys): returns
+
+      (keys, tie_rank, min_tie_index)
+
+    where ``keys[t]`` is the u32 key at sorted position ``ranks[t]``,
+    ``tie_rank[t] = ranks[t] - #{u < keys[t]}`` is the target's 0-based
+    position INSIDE its tie group (what a stable sort resolves by
+    original index), and ``min_tie_index[t]`` is the smallest element
+    index with ``u == keys[t]`` (clipped to n-1; only meaningful when
+    the key is actually present, which it always is for ranks < m).
+    Pure histogram range-narrowing: 3 fused bincount passes + 1
+    scatter-min, never a sorted array of the data.
+    """
+    R = ranks.shape[0]
+    n = u.shape[0]
+    rank_rem = ranks.astype(xp.int32)
+    idx = xp.arange(n, dtype=xp.int32)
+
+    # -- pass 1: 16-bit leading digit, one shared full-range interval ----
+    d1 = (u >> xp.uint32(_PASS1_BITS)).astype(xp.int32)
+    hist1 = _segment_count(d1, 1 << _PASS1_BITS, xp)
+    cum1 = xp.cumsum(hist1)
+    pfx = xp.searchsorted(cum1, rank_rem, side="right").astype(xp.int32)
+    below = xp.where(pfx > 0, cum1[xp.maximum(pfx - 1, 0)], 0)
+    rank_rem = rank_rem - below.astype(xp.int32)
+
+    # -- pass 2: dense 2^16 prefix->row LUT, 8-bit digit ----------------
+    # duplicate target prefixes share the minimum target index as their
+    # row (scatter-min), so shared intervals share one histogram row; a
+    # LUT slot below R exists ONLY for active prefixes, so the row test
+    # doubles as the membership test
+    lut2 = (
+        xp.full((1 << _PASS1_BITS,), R, dtype=xp.int32)
+        .at[pfx]
+        .min(xp.arange(R, dtype=xp.int32))
+    )
+    row2 = lut2[d1]
+    d2 = ((u >> xp.uint32(_PASS_BITS)) & xp.uint32(_B - 1)).astype(xp.int32)
+    seg2 = xp.where(row2 < R, row2 * _B + d2, R * _B)
+    hist2 = _segment_count(seg2, R * _B + 1, xp)[: R * _B].reshape(R, _B)
+    tcum2 = xp.cumsum(hist2, axis=1)[lut2[pfx]]
+    bucket2, below2 = _bucket_of_rank(tcum2, rank_rem, xp)
+    rank_rem = rank_rem - below2
+
+    # -- pass 3: interval id = pass-2 cell (row2, digit2); the dense LUT
+    # over the R*B cell space maps it to <= R rows ----------------------
+    id3_t = lut2[pfx] * _B + bucket2
+    lut3 = (
+        xp.full((R * _B + 1,), R, dtype=xp.int32)
+        .at[id3_t]
+        .min(xp.arange(R, dtype=xp.int32))
+    )
+    row3 = lut3[xp.minimum(seg2, R * _B)]
+    d3 = (u & xp.uint32(_B - 1)).astype(xp.int32)
+    seg3 = xp.where(row3 < R, row3 * _B + d3, R * _B)
+    hist3 = _segment_count(seg3, R * _B + 1, xp)[: R * _B].reshape(R, _B)
+    tcum3 = xp.cumsum(hist3, axis=1)[lut3[id3_t]]
+    bucket3, below3 = _bucket_of_rank(tcum3, rank_rem, xp)
+    rank_rem = rank_rem - below3
+
+    keys = (
+        (pfx.astype(xp.uint32) << xp.uint32(2 * _PASS_BITS))
+        | (bucket2.astype(xp.uint32) << xp.uint32(_PASS_BITS))
+        | bucket3.astype(xp.uint32)
+    )
+
+    # tie rider source: after pass 3 a (row3, digit3) cell holds exactly
+    # one distinct key, so the pass-3 segment ids double as tie-group ids
+    # — one scatter-min finds each target's minimum-index tie element
+    min_cell = (
+        xp.full((R * _B + 1,), n, dtype=xp.int32).at[seg3].min(idx)
+    )
+    min_tie_index = xp.minimum(
+        min_cell[lut3[id3_t] * _B + bucket3], n - 1
+    )
+    return keys, rank_rem, min_tie_index
+
+
+def chunk_summary_select(x, valid, sketch_size: int, local_n: int, xp, lo):
+    """Inside-jit: one chunk/shard -> the SAME fixed-shape weighted
+    summary as ``kll_device.chunk_summary``, computed by multi-rank
+    histogram selection instead of a device sort.
+
+    ``lo`` is REQUIRED (the two-float pair planes are the selection key
+    domain); wide-f64 columns stay on the sort path — the planner
+    (ops/scan_plan.py) only routes pair/i32/hi-only layouts here.
+    Returns {items (k+W,), weights (k+W,), count, min, max} with padding
+    slots at weight 0, foldable by ``fold_summaries`` interchangeably
+    with the sort path's summary.
+    """
+    from deequ_tpu.ops.df32 import masked_extremum
+
+    k = sketch_size
+    W = strata_capacity(local_n, k)
+
+    # invalid rows take the +inf KEY — the sort path pads them with
+    # literal +inf (`where(valid, x, inf)`), so they must join the same
+    # tie group valid +inf values occupy, not a separate sentinel: with
+    # valid NaNs present (numpy sort order puts NaNs after the padding)
+    # ranks in [r0, m) can legitimately resolve to padding +inf, and the
+    # selection must reproduce exactly that
+    u = xp.where(valid, monotone_u32(x, xp), monotone_u32(
+        xp.asarray(np.float32(np.inf)), xp
+    ))
+    lo_plane = xp.where(valid, lo, xp.asarray(np.float32(0.0)))
+
+    m = valid.sum()
+    w, n_strata = strata_weight(m, k, xp)
+    r0 = (n_strata * w).astype(xp.int32)  # first remainder rank
+
+    # target ranks: k stratum midpoints + the remainder's [r0, m-1] rank
+    # bounds, every one clipped into [0, m) so padded targets resolve
+    # harmlessly (their weight is zeroed below, exactly like the sort
+    # path's gather clip)
+    sidx = xp.arange(k, dtype=xp.int32) * w.astype(xp.int32) + (
+        w.astype(xp.int32) // 2
+    )
+    hi_rank = xp.maximum(m.astype(xp.int32) - 1, 0)
+    targets = xp.concatenate(
+        [
+            xp.clip(sidx, 0, hi_rank),
+            xp.clip(r0, 0, hi_rank)[None],
+            hi_rank[None],
+        ]
+    )
+
+    keys, tie_rank, tie_src = _select_u32_multirank(u, targets, xp)
+    sel64 = inverse_monotone_u32(keys, xp).astype(xp.float64) + lo_plane[
+        tie_src
+    ].astype(xp.float64)
+
+    s_on = xp.arange(k) < n_strata
+    items_s = sel64[:k]
+    weights_s = xp.where(s_on, w, 0)
+
+    # exact remainder: the elements a stable argsort places at ranks
+    # [r0, m) — bounded BELOW by the key at rank r0 and ABOVE by the key
+    # at rank m-1, ties on either boundary split by original index order.
+    # Both bounds are needed: rows the sort path pads with +inf can sit
+    # at ranks >= m inside the same +inf tie group the remainder's top
+    # ranks occupy, so "everything above the threshold" would overrun.
+    v_b, v_t = keys[k], keys[k + 1]
+    j0, j1 = tie_rank[k], tie_rank[k + 1]
+    has_rem = r0 < m.astype(xp.int32)
+    tie_b = u == v_b
+    tie_t = u == v_t
+    pos_b = xp.cumsum(tie_b.astype(xp.int32)) - 1
+    pos_t = xp.cumsum(tie_t.astype(xp.int32)) - 1
+    above = (u > v_b) | (tie_b & (pos_b >= j0))
+    below = (u < v_t) | (tie_t & (pos_t <= j1))
+    rem = has_rem & above & below
+    slot = xp.cumsum(rem.astype(xp.int32)) - 1
+    # item values come from the PADDED plane (invalid rows read as +inf,
+    # lo zeroed) — the exact array the sort path gathers from
+    x64 = xp.where(
+        valid, x, xp.asarray(np.float32(np.inf))
+    ).astype(xp.float64) + lo_plane.astype(xp.float64)
+    items_r = (
+        xp.zeros((W,), dtype=xp.float64)
+        .at[xp.where(rem, slot, W)]
+        .set(x64, mode="drop")
+    )
+    n_rem = xp.where(has_rem, m.astype(xp.int32) - r0, 0)
+    weights_r = xp.where(xp.arange(W, dtype=xp.int32) < n_rem, 1, 0)
+
+    items = xp.concatenate([items_s, items_r])
+    weights = xp.concatenate([weights_s, weights_r])
+    items = xp.where(weights > 0, items, 0.0)
+
+    mn = masked_extremum(x, lo, valid, xp, "min")
+    mx = masked_extremum(x, lo, valid, xp, "max")
+    return {
+        "items": items,
+        "weights": weights.astype(xp.float64),
+        "count": m,
+        "min": mn,
+        "max": mx,
+    }
+
+
+def chunk_summary_select_batched(X, M, sketch_size: int, local_n: int, xp, lo):
+    """K columns at once: (K, n) values + (K, n) validity + (K, n) lo
+    planes -> summaries with a leading K axis. The histogram passes of
+    every column run in ONE vmapped dispatch per pass (a (K, R*B) fused
+    bincount), the batched analogue of ``chunk_summary_batched``'s
+    vmapped sort — at O(passes * n) work instead of O(n log n)
+    comparison sorting."""
+    import jax
+
+    return jax.vmap(
+        lambda xc, vc, lc: chunk_summary_select(
+            xc, vc, sketch_size, local_n, xp, lo=lc
+        )
+    )(X, M, lo)
