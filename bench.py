@@ -212,6 +212,59 @@ def measure_config3_selection(n_rows: int):
     }
 
 
+def measure_plan_lint_overhead(table, analyzers):
+    """Static plan-lint cost probe (deequ_tpu/lint) on the resident
+    profile scan already warmed by the main bench: ``plan_lint_overhead_ms``
+    is the wall added by the FIRST linted scan (which pays the one-time
+    jaxpr trace + rule checks) over an unlinted scan of the same warmed
+    program. The memoization contract is hard-asserted: a second linted
+    scan of an identical plan must perform ZERO additional lint traces
+    (``SCAN_STATS.plan_lint_traces``) — the lint result rides the
+    program cache identity, so enforcement is one trace per
+    (plan, kernel-variant), not per scan."""
+    import os
+
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.lint.plan_lint import clear_lint_memo
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    def run():
+        SCAN_STATS.reset()
+        t0 = time.time()
+        ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+        wall = time.time() - t0
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        return wall, SCAN_STATS.plan_lint_traces, SCAN_STATS.plan_lints
+
+    prev = os.environ.get("DEEQU_TPU_PLAN_LINT")
+    try:
+        os.environ["DEEQU_TPU_PLAN_LINT"] = "off"
+        base, _, _ = run()
+        os.environ["DEEQU_TPU_PLAN_LINT"] = "error"
+        clear_lint_memo()
+        first, traces_first, lints = run()
+        assert traces_first >= 1, "plan lint armed but no lint trace ran"
+        assert lints == [], f"resident profile scan has lint findings: {lints}"
+        second, traces_second, _ = run()
+        assert traces_second == 0, (
+            "plan-lint memoization regression: a second scan of an "
+            f"identical plan performed {traces_second} additional lint "
+            "trace(s)"
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("DEEQU_TPU_PLAN_LINT", None)
+        else:
+            os.environ["DEEQU_TPU_PLAN_LINT"] = prev
+    return {
+        "plan_lint_overhead_ms": round(max(first - base, 0.0) * 1000, 2),
+        "plan_lint_memoized_overhead_ms": round(
+            max(second - base, 0.0) * 1000, 2
+        ),
+        "plan_lint_traces_first_scan": traces_first,
+    }
+
+
 def measure_oom_bisection_overhead(n_rows: int):
     """Device-fault degradation cost probe: the same in-memory analysis
     timed clean vs with a seeded device OOM injected on its first attempt
@@ -446,7 +499,15 @@ def main():
         SMOKE_ROWS if smoke else 200_000
     )
     print(f"config-3 selection probe: {select_probe}", file=sys.stderr)
-    ckpt_probe = {**ckpt_probe, **oom_probe, **reshard_probe, **select_probe}
+    # plan-lint cost + memoization contract on the ALREADY-WARMED
+    # resident profile table (no extra data gen; the probe's unlinted
+    # baseline reuses the compiled program)
+    lint_probe = measure_plan_lint_overhead(table, analyzers)
+    print(f"plan-lint probe: {lint_probe}", file=sys.stderr)
+    ckpt_probe = {
+        **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
+        **lint_probe,
+    }
 
     if smoke:
         print(

@@ -24,6 +24,8 @@ Architecture (see SURVEY.md for the reference layer map):
   - ``deequ_tpu.anomaly``   — anomaly detection strategies
   - ``deequ_tpu.profiles``  — column profiler
   - ``deequ_tpu.suggestions`` — constraint suggestion rules
+  - ``deequ_tpu.lint``      — static contract checking (jaxpr plan lint +
+                              AST repo lint; docs/static_analysis.md)
 
 Numeric note: metric semantics follow the reference's double precision; we
 enable jax x64 so device aggregation states are float64 (bandwidth-bound, not
@@ -72,6 +74,8 @@ from deequ_tpu.exceptions import (  # noqa: E402
     DeviceOOMException,
     MeshDegradedException,
     PeerLostException,
+    PlanLintError,
+    PlanLintWarning,
 )
 from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
 from deequ_tpu.verification import (  # noqa: E402
@@ -110,6 +114,8 @@ __all__ = [
     "DeviceHangException",
     "MeshDegradedException",
     "PeerLostException",
+    "PlanLintError",
+    "PlanLintWarning",
     "DoubleMetric",
     "Entity",
     "HistogramMetric",

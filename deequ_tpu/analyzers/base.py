@@ -22,6 +22,7 @@ from deequ_tpu.exceptions import (
     NoColumnsSpecifiedException,
     NoSuchColumnException,
     NumberOfSpecifiedColumnsException,
+    PlanLintError,
     WrongColumnTypeException,
     wrap_if_necessary,
 )
@@ -160,6 +161,11 @@ class Analyzer(ABC):
                 state = self.compute_state_from_stream(table)
             else:
                 state = self.compute_state_from(table)
+        except PlanLintError:
+            # static contract violations raise typed through every
+            # surface (the plan_lint="error" contract): planner drift is
+            # a programming error, never a data-quality failure metric
+            raise
         except Exception as e:  # noqa: BLE001
             return self.to_failure_metric(wrap_if_necessary(e))
         return self.calculate_metric(state, aggregate_with, save_states_with)

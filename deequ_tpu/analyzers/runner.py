@@ -39,6 +39,7 @@ from deequ_tpu.data.table import ColumnarTable, Schema
 from deequ_tpu.exceptions import (
     GroupBudgetIgnoredWarning,
     MetricCalculationRuntimeException,
+    PlanLintError,
     ReusingNotPossibleResultsMissingException,  # noqa: F401 — canonical home
     # is the exceptions taxonomy; re-exported here for compatibility (the
     # class was born in this module)
@@ -455,6 +456,13 @@ class AnalysisRunner:
                 device_deadline=device_deadline,
                 shard_deadline=shard_deadline,
             )
+        except PlanLintError:
+            # a static contract violation is a PROGRAMMING error caught
+            # pre-dispatch (planner drift, mis-tagged fold leaf), not
+            # data: the error-mode contract is that it RAISES typed
+            # through VerificationSuite (verification.py docstring)
+            # instead of masquerading as per-analyzer failure metrics
+            raise
         except Exception as e:  # noqa: BLE001 — a failure inside the shared
             # scan maps onto every participating analyzer (reference L320-323)
             wrapped = wrap_if_necessary(e)
@@ -568,8 +576,16 @@ class AnalysisRunner:
                         continue
                     try:
                         folders[a].add(a.compute_state_from(batch))
+                    except PlanLintError:
+                        raise  # static contract violation: typed, never a metric
                     except Exception as e:  # noqa: BLE001
                         failed[a] = e
+        except PlanLintError:
+            # typed through every surface (plan_lint="error" contract);
+            # still release spill stores so temp dirs don't outlive us
+            for f in folders.values():
+                _release_spill(f)
+            raise
         except Exception as e:  # noqa: BLE001 — a source/read error fails
             # every analyzer of the pass (the shared-scan failure rule);
             # release any spill stores so temp dirs don't outlive the run
@@ -877,6 +893,8 @@ class AnalysisRunner:
                     continue
                 try:
                     folders[keys[a]].add(a.compute_state_from(batch))
+                except PlanLintError:
+                    raise  # static contract violation: typed, never a metric
                 except Exception as e:  # noqa: BLE001
                     failed[a] = a.to_failure_metric(wrap_if_necessary(e))
             for g in by_grouping:

@@ -54,6 +54,7 @@ class BaseChangeStrategy(AnomalyDetectionStrategy):
         start, end = search_interval
         if start > end:
             raise ValueError("The start of the interval cannot be larger than the end.")
+        # deequ-lint: ignore[host-fetch] -- data_series is the host-side metric history, no device value reaches it
         series = np.asarray(data_series, dtype=np.float64)
         end = min(end, len(series))
         start_point = max(start - self.order, 0)
@@ -285,6 +286,7 @@ class BatchNormalStrategy(AnomalyDetectionStrategy):
             raise ValueError("The start of the interval can't be larger than the end.")
         if len(data_series) == 0:
             raise ValueError("Data series is empty. Can't calculate mean/ stdDev.")
+        # deequ-lint: ignore[host-fetch] -- data_series is the host-side metric history, no device value reaches it
         series = np.asarray(data_series, dtype=np.float64)
         search_end_clamped = min(search_end, len(series))
         interval_length = search_end_clamped - search_start

@@ -94,6 +94,33 @@ class RetryExhaustedException(MetricCalculationRuntimeException):
         self.__cause__ = cause
 
 
+class PlanLintError(MetricCalculationException):
+    """A static contract violation found in a scan program BEFORE dispatch
+    (deequ_tpu/lint/plan_lint.py): the traced jaxpr of a ``ScanPlan``-built
+    program contradicts the contracts the plan declares — a
+    selection-variant plan containing a ``sort`` primitive, a host
+    callback inside a one-fetch fused program, a fold leaf whose merge
+    disagrees with its registered reduction tag. Raised at trace time,
+    per plan, under ``run_scan(plan_lint="error")`` /
+    ``DEEQU_TPU_PLAN_LINT=error`` — the static twin of the runtime
+    counter asserts (``device_sort_passes``/``device_fetches``), catching
+    planner/packer drift before a single chunk dispatches.
+
+    ``findings`` carries the structured finding rows (rule, severity,
+    message) the lint pass produced."""
+
+    def __init__(self, message: str, findings=()):
+        super().__init__(message)
+        self.findings = tuple(findings)
+
+
+class PlanLintWarning(UserWarning):
+    """A plan-lint finding surfaced in ``plan_lint="warn"`` mode (or a
+    warning-severity finding in ``"error"`` mode): the scan proceeds, the
+    finding is recorded on ``ScanStats.plan_lints``, and deployments can
+    escalate or silence it through the standard warnings filters."""
+
+
 class GroupBudgetIgnoredWarning(UserWarning):
     """``group_memory_budget`` was configured together with checkpointing:
     mid-store spill state is not serializable, so spill is disabled and

@@ -1,0 +1,49 @@
+"""deequ_tpu.lint — two-level static contract checking.
+
+Level 1 (:mod:`deequ_tpu.lint.plan_lint`) walks the closed jaxpr of a
+``ScanPlan``-built scan program before dispatch and checks the IR against
+the contracts the plan declares (zero-sort selection variants, no host
+callbacks inside one-fetch programs, fold-leaf/reduction-tag
+consistency, deterministic scatter order). Wired into ``run_scan`` via
+``plan_lint="error"|"warn"|"off"`` and ``DEEQU_TPU_PLAN_LINT``; findings
+surface on ``ScanStats.plan_lints`` / ``VerificationResult.plan_lints``.
+
+Level 2 (:mod:`deequ_tpu.lint.repo_lint`) is an AST pass over the
+codebase enforcing the conventions the engine PRs established by hand —
+``python -m deequ_tpu.lint`` is the CI gate.
+
+See docs/static_analysis.md for the rule catalog and suppression syntax.
+"""
+
+from deequ_tpu.exceptions import PlanLintError, PlanLintWarning
+from deequ_tpu.lint.findings import LintFinding
+from deequ_tpu.lint.plan_lint import (
+    PLAN_LINT_MODES,
+    clear_lint_memo,
+    enforce_plan_lint,
+    lint_plan,
+    lint_plan_cached,
+    plan_lint_mode,
+    primitive_census,
+)
+from deequ_tpu.lint.repo_lint import (
+    RULE_SCOPES,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "LintFinding",
+    "PlanLintError",
+    "PlanLintWarning",
+    "PLAN_LINT_MODES",
+    "RULE_SCOPES",
+    "clear_lint_memo",
+    "enforce_plan_lint",
+    "lint_plan",
+    "lint_plan_cached",
+    "lint_paths",
+    "lint_source",
+    "plan_lint_mode",
+    "primitive_census",
+]

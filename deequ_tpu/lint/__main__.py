@@ -1,0 +1,65 @@
+"""CLI for the repo lint: ``python -m deequ_tpu.lint [paths...]``.
+
+Exit codes: 0 = no findings, 1 = findings, 2 = usage error. With no
+paths the installed ``deequ_tpu`` package is linted — the invocation CI
+runs (tier-1 requires a zero-finding repo).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from deequ_tpu.lint.repo_lint import RULE_SCOPES, lint_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deequ_tpu.lint",
+        description=(
+            "Static convention checker for the deequ_tpu codebase "
+            "(rule catalog: docs/static_analysis.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: the deequ_tpu package)",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule ids and their path scopes, then exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, scopes in sorted(RULE_SCOPES.items()):
+            where = ", ".join(s or "<package>" for s in scopes)
+            print(f"{rule}: {where}")
+        return 0
+
+    rules = None
+    if args.rules is not None:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULE_SCOPES]
+        if unknown:
+            print(f"unknown rule(s): {unknown}", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(args.paths, rules)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

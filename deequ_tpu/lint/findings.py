@@ -1,0 +1,42 @@
+"""Shared finding type for both lint levels (plan lint over jaxprs,
+repo lint over the codebase AST). A finding is DATA — typed, ranked by
+severity, locatable — so callers (the CLI, ``ScanStats.plan_lints``,
+``VerificationResult.plan_lints``, tests) never parse strings."""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+#: finding severities, most severe first
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    """One static-analysis finding.
+
+    ``rule`` is the stable rule id (``plan-*`` for the jaxpr pass,
+    bare ids like ``host-fetch`` for the AST pass); ``severity`` is
+    ``"error"`` (contract violation: rejected under enforcement) or
+    ``"warning"`` (surfaced, never fatal); ``location`` is
+    ``path:line`` for repo findings and a plan/op label for plan
+    findings."""
+
+    rule: str
+    severity: str
+    message: str
+    location: str = ""
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"finding severity must be one of {SEVERITIES}, "
+                f"got {self.severity!r}"
+            )
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+    def __str__(self) -> str:
+        loc = f"{self.location}: " if self.location else ""
+        return f"{loc}[{self.rule}] {self.severity}: {self.message}"

@@ -1,0 +1,379 @@
+"""Plan lint — static contract checking over the jaxpr of scan programs.
+
+Every contract the engine lives by is (so far) enforced at runtime, by
+counters and asserts that fire AFTER a bad program has compiled and
+dispatched: the zero-sort selection contract is a bench assert over
+``device_sort_passes``, the one-fetch contract an assert over
+``device_fetches``, fold-order bit-identity a documented invariant. This
+module is their static twin: it walks the closed jaxpr of a
+``ScanPlan``-built program (``jax.make_jaxpr`` on the fused flat step,
+BEFORE any dispatch) and checks the IR against the contracts the plan
+*declares* (``ScanPlan.variant`` / ``fold_tags`` / ``fetch_contract`` —
+ops/scan_plan.py), so planner/packer drift is caught at trace time.
+
+Rules (ids are stable; severities per ``findings.LintFinding``):
+
+- ``plan-select-sort`` (error) — a plan declared ``variant="select"``
+  (every summary op routed through the histogram selection kernel) whose
+  traced program contains a ``sort`` primitive. The runtime pair
+  ``device_select_passes``/``device_sort_passes`` would catch this after
+  a full bench run; the lint rejects the program before dispatch.
+- ``plan-host-callback`` (error) — the traced program contains a host
+  callback / infeed / outfeed primitive. Fused scan programs are
+  transfer-free by construction (the one-fetch contract pays its single
+  device->host fetch OUTSIDE the program, at the drain); a callback
+  smuggled into the IR re-introduces per-chunk host round trips that
+  ``device_fetches`` cannot even see.
+- ``plan-fold-tag`` (error) — the plan's declared ``fold_tags`` disagree
+  with the reduction-tag leaves actually registered on its ops, or name
+  a tag outside the known monoid set. An ``add``-declared leaf whose op
+  actually merges with ``max`` silently corrupts every cross-chunk and
+  cross-shard merge.
+- ``plan-fold-merge`` (error) — the traced merge kernel
+  (``ops/df32.merge_tags_f64``, the jaxpr the device fold compiles)
+  evaluated on probe values disagrees with a leaf's registered tag: the
+  IR-level check that a 'sum' leaf adds, a 'min' leaf takes minima, a
+  'max' leaf maxima.
+- ``plan-nondet-scatter`` (warning) — a floating-point ``scatter-add``
+  with ``unique_indices=False`` on a path documented bit-identical
+  (docs/numerics.md): unsorted float scatter accumulation order is
+  backend-dependent. Integer scatter-adds are exempt (integer addition
+  is exactly associative — the selection kernel's histogram passes).
+
+Results are memoized per (program identity, variant, mesh) so
+enforcement costs one trace per plan/kernel-variant, not one per scan —
+the engine observes actual traces via ``ScanStats.plan_lint_traces``.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter, OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deequ_tpu.exceptions import PlanLintError, PlanLintWarning
+from deequ_tpu.lint.findings import LintFinding
+
+#: enforcement modes run_scan accepts (DEEQU_TPU_PLAN_LINT takes the
+#: same values); "off" is the default — lint is opt-in per run/process
+PLAN_LINT_MODES = ("error", "warn", "off")
+
+#: primitives that ARE a device sort (the zero-sort contract's subject —
+#: matches what ScanOp.sorts_chunk counts at runtime)
+_SORT_PRIMITIVES = frozenset(("sort",))
+
+#: primitives that cross the host boundary from inside a traced program
+_CALLBACK_PRIMITIVES = frozenset(
+    (
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "callback",
+        "host_callback",
+        "infeed",
+        "outfeed",
+    )
+)
+
+#: float-accumulating scatter primitives whose unsorted reduction order
+#: is backend-dependent (scatter-min/max and integer adds are exact)
+_ORDER_SENSITIVE_SCATTERS = frozenset(("scatter-add", "scatter-mul"))
+
+#: probe values distinguishing the three elementwise monoid merges:
+#: merge(2, 3) is 5 under sum, 2 under min, 3 under max
+_MERGE_PROBES = {"sum": 5.0, "min": 2.0, "max": 3.0}
+
+
+def plan_lint_mode(param: Optional[str] = None) -> str:
+    """Resolve the plan-lint enforcement mode: explicit argument wins,
+    then the DEEQU_TPU_PLAN_LINT env var, then "off". Validated against
+    PLAN_LINT_MODES (typed ValueError, like the select-kernel switch)."""
+    if param is not None:
+        if param not in PLAN_LINT_MODES:
+            raise ValueError(
+                f"plan_lint must be one of {PLAN_LINT_MODES}, got {param!r}"
+            )
+        return param
+    raw = os.environ.get("DEEQU_TPU_PLAN_LINT", "").strip()
+    if raw == "":
+        return "off"
+    if raw not in PLAN_LINT_MODES:
+        raise ValueError(
+            f"DEEQU_TPU_PLAN_LINT must be one of {PLAN_LINT_MODES}, "
+            f"got {raw!r}"
+        )
+    return raw
+
+
+def iter_eqns(jaxpr):
+    """Yield every equation of ``jaxpr`` INCLUDING nested sub-jaxprs
+    (pjit bodies, scan/while/cond branches, shard_map bodies, custom-call
+    envelopes) — jnp-level code routinely wraps its primitives in a pjit
+    equation, so a flat walk would see almost nothing."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for param in eqn.params.values():
+            for sub in _subjaxprs(param):
+                yield from iter_eqns(sub)
+
+
+def _subjaxprs(value) -> List[Any]:
+    out: List[Any] = []
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if hasattr(v, "jaxpr") and hasattr(v, "consts"):  # ClosedJaxpr
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns"):  # raw Jaxpr
+            out.append(v)
+        elif isinstance(v, (tuple, list)):
+            stack.extend(v)
+    return out
+
+
+def primitive_census(closed_jaxpr) -> Counter:
+    """Recursive primitive-name counts of a (closed) jaxpr."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    return Counter(eqn.primitive.name for eqn in iter_eqns(jaxpr))
+
+
+def _float_unsorted_scatters(jaxpr) -> int:
+    n = 0
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in _ORDER_SENSITIVE_SCATTERS:
+            continue
+        if eqn.params.get("unique_indices", False):
+            continue
+        if any(
+            np.issubdtype(v.aval.dtype, np.floating) for v in eqn.outvars
+        ):
+            n += 1
+    return n
+
+
+def _check_fold_tags(plan_ir) -> List[LintFinding]:
+    """Declared fold tags vs the tags actually registered on the resolved
+    ops — the planner metadata the executor's fold layer will obey."""
+    import jax
+
+    from deequ_tpu.ops.scan_plan import KNOWN_FOLD_TAGS
+
+    findings: List[LintFinding] = []
+    declared = plan_ir.fold_tags
+    if len(declared) != len(plan_ir.ops):
+        findings.append(
+            LintFinding(
+                "plan-fold-tag",
+                "error",
+                f"plan declares fold tags for {len(declared)} ops but "
+                f"resolved {len(plan_ir.ops)} ops",
+            )
+        )
+        return findings
+    for i, (op, tags) in enumerate(zip(plan_ir.ops, declared)):
+        label = f"op[{i}]={op.cache_key!r}"
+        actual = tuple(str(t) for t in jax.tree.leaves(op.tags))
+        bad = [t for t in tags if t not in KNOWN_FOLD_TAGS]
+        if bad:
+            findings.append(
+                LintFinding(
+                    "plan-fold-tag",
+                    "error",
+                    f"unknown reduction tag(s) {bad} declared "
+                    f"(known: {sorted(KNOWN_FOLD_TAGS)})",
+                    location=label,
+                )
+            )
+        if tags != actual:
+            findings.append(
+                LintFinding(
+                    "plan-fold-tag",
+                    "error",
+                    f"declared fold tags {tags} != tags registered on the "
+                    f"op {actual}: the fold layer would merge with the "
+                    "declared monoid while the op computes the other — a "
+                    "silent cross-chunk corruption",
+                    location=label,
+                )
+            )
+    return findings
+
+
+def _check_fold_merge(plan_ir) -> List[LintFinding]:
+    """Evaluate the device merge kernel per elementwise leaf tag:
+    compose ``merge_tags_f64`` exactly as ``_DeviceFoldPlan`` does
+    (boolean tag masks) and evaluate it on probe values — a 'sum' leaf
+    must add, 'min' must take minima, 'max' maxima. Evaluated by direct
+    call (semantically the traced program — the function is pure jnp),
+    not via jaxpr interpretation: ``jax.core.eval_jaxpr`` is an
+    internal API newer jax releases remove, and an armed lint must not
+    crash on a jax upgrade."""
+    import jax.numpy as jnp
+
+    from deequ_tpu.ops.df32 import merge_tags_f64
+
+    elem_tags = sorted(
+        {
+            t
+            for tags in plan_ir.fold_tags
+            for t in tags
+            if t in _MERGE_PROBES
+        }
+    )
+    if not elem_tags:
+        return []
+    is_sum = np.array([t == "sum" for t in elem_tags])
+    is_min = np.array([t == "min" for t in elem_tags])
+    acc = np.full(len(elem_tags), 2.0)
+    new = np.full(len(elem_tags), 3.0)
+    merged = np.asarray(merge_tags_f64(is_sum, is_min, acc, new, jnp))
+    findings: List[LintFinding] = []
+    for i, tag in enumerate(elem_tags):
+        expect = _MERGE_PROBES[tag]
+        if merged[i] != expect:
+            findings.append(
+                LintFinding(
+                    "plan-fold-merge",
+                    "error",
+                    f"merge kernel evaluates a '{tag}' leaf to "
+                    f"{merged[i]} on probe (2, 3); expected {expect} — "
+                    "the compiled fold merge disagrees with the "
+                    "registered monoid",
+                    location=f"tag={tag}",
+                )
+            )
+    return findings
+
+
+def lint_plan(
+    plan_ir,
+    trace_fn: Optional[Callable] = None,
+    avals: Sequence[Any] = (),
+) -> List[LintFinding]:
+    """Run every plan-lint rule against ``plan_ir`` (a
+    ``ops/scan_plan.ScanPlan``) and, when ``trace_fn`` is given, the
+    jaxpr of ``trace_fn(*avals)`` — the fused flat step the executor
+    will jit. Returns the findings, errors first; empty means the
+    program satisfies every declared contract."""
+    import jax
+
+    findings: List[LintFinding] = []
+    findings += _check_fold_tags(plan_ir)
+    # a corrupt tag declaration makes the merge probe meaningless — and
+    # the probe would crash on an unknown tag before reporting cleanly
+    if not findings:
+        findings += _check_fold_merge(plan_ir)
+
+    if trace_fn is not None:
+        closed = jax.make_jaxpr(trace_fn)(*avals)
+        census = primitive_census(closed)
+        sorts = sum(census.get(p, 0) for p in _SORT_PRIMITIVES)
+        if plan_ir.variant == "select" and sorts:
+            findings.append(
+                LintFinding(
+                    "plan-select-sort",
+                    "error",
+                    f"selection-variant plan traces to a program with "
+                    f"{sorts} sort primitive(s): the zero-sort contract "
+                    "(device_sort_passes == 0 on the resident selection "
+                    "path) is violated before dispatch",
+                )
+            )
+        callbacks = {
+            p: census[p] for p in _CALLBACK_PRIMITIVES if census.get(p)
+        }
+        if callbacks:
+            findings.append(
+                LintFinding(
+                    "plan-host-callback",
+                    "error",
+                    f"scan program contains host-boundary primitive(s) "
+                    f"{callbacks}: fused programs must be transfer-free "
+                    f"(fetch contract: {plan_ir.fetch_contract}; the one "
+                    "fetch happens at the drain, outside the program)",
+                )
+            )
+        nondet = _float_unsorted_scatters(closed.jaxpr)
+        if nondet:
+            findings.append(
+                LintFinding(
+                    "plan-nondet-scatter",
+                    "warning",
+                    f"{nondet} floating-point scatter-add(s) with "
+                    "unsorted, non-unique indices: accumulation order is "
+                    "backend-dependent on a path documented bit-identical "
+                    "(docs/numerics.md, fold order and determinism)",
+                )
+            )
+    findings.sort(key=lambda f: (f.severity != "error", f.rule))
+    return findings
+
+
+# -- memoization --------------------------------------------------------
+#
+# one lint trace per (program identity, variant, mesh, backend), mirroring
+# the executor's program caches: repeated scans of an identical plan pay
+# a dict lookup, not a retrace. Bounded like _GLOBAL_PROGRAMS.
+
+_MEMO_CAP = 256
+_LINT_MEMO: "OrderedDict[Any, Tuple[LintFinding, ...]]" = OrderedDict()
+
+
+def lint_plan_cached(
+    plan_ir,
+    trace_fn: Optional[Callable],
+    avals: Sequence[Any],
+    memo_key: Any,
+) -> Tuple[List[LintFinding], bool]:
+    """Memoizing wrapper around :func:`lint_plan`. Returns
+    ``(findings, traced)`` — ``traced`` is False on a memo hit (the
+    observable behind ``ScanStats.plan_lint_traces`` and the bench
+    memoization assert). ``memo_key=None`` disables memoization (plans
+    whose ops opted out of program caching re-lint per scan)."""
+    if memo_key is not None:
+        cached = _LINT_MEMO.get(memo_key)
+        if cached is not None:
+            _LINT_MEMO.move_to_end(memo_key)
+            return list(cached), False
+    findings = lint_plan(plan_ir, trace_fn, avals)
+    if memo_key is not None:
+        _LINT_MEMO[memo_key] = tuple(findings)
+        while len(_LINT_MEMO) > _MEMO_CAP:
+            _LINT_MEMO.popitem(last=False)
+    return findings, True
+
+
+def clear_lint_memo() -> None:
+    """Drop every memoized lint result (tests; also the right response
+    to hot-swapping op update fns in a long-lived process)."""
+    _LINT_MEMO.clear()
+
+
+def enforce_plan_lint(
+    findings: Sequence[LintFinding], mode: str
+) -> None:
+    """Apply an enforcement mode to a finding list: ``"error"`` raises
+    ``PlanLintError`` on the first error-severity finding (warnings still
+    warn), ``"warn"`` warns for everything, ``"off"`` is a no-op. Always
+    call BEFORE dispatch — the whole point is rejecting the program while
+    it is still just IR."""
+    import warnings
+
+    if mode == "off" or not findings:
+        return
+    errors = [f for f in findings if f.severity == "error"]
+    warnings_only = [f for f in findings if f.severity != "error"]
+    for f in warnings_only:
+        warnings.warn(str(f), PlanLintWarning, stacklevel=3)
+    if not errors:
+        return
+    if mode == "error":
+        raise PlanLintError(
+            "plan lint rejected the scan program before dispatch:\n"
+            + "\n".join(str(f) for f in errors),
+            findings=findings,
+        )
+    for f in errors:
+        warnings.warn(str(f), PlanLintWarning, stacklevel=3)

@@ -105,6 +105,7 @@ def _call_with_deadline(fn: Callable, deadline: float, what: str,
     def run():
         try:
             box["value"] = fn()
+        # deequ-lint: ignore[bare-except] -- watchdog worker forwards the exception to the caller thread via box['error'], re-raised there
         except BaseException as e:  # noqa: BLE001 — re-raised on the caller
             box["error"] = e
         finally:

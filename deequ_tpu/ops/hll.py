@@ -114,6 +114,7 @@ def hash_strings(values, seed: int = XXHASH_SEED) -> np.ndarray:
     hashed = native.hash_strings(values, seed)
     if hashed is not None:
         return hashed
+    # deequ-lint: ignore[host-fetch] -- pure-python hash fallback over host strings
     return np.array(
         [xxhash64_bytes(str(v).encode("utf-8"), seed) for v in values],
         dtype=np.uint64,
@@ -432,6 +433,7 @@ def estimate_cardinality(registers: np.ndarray) -> float:
     estimator is table-free AND unbiased across the whole range — no
     copied constants, tighter error than interpolated bias correction.
     """
+    # deequ-lint: ignore[host-fetch] -- partials arrive host-side, drained (and accounted) by the scan fetch
     registers = np.asarray(registers)
     m = len(registers)
     p = int(round(math.log2(m)))

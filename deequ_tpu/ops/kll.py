@@ -78,6 +78,7 @@ class KLLSketchState:
         if len(values) == 0:
             return
         self.compactors[0] = np.concatenate(
+            # deequ-lint: ignore[host-fetch] -- compactors and update values are host arrays by design (KLL keeps the host fold)
             [self.compactors[0], np.asarray(values, dtype=np.float64)]
         )
         self.count += len(values)
@@ -196,6 +197,7 @@ class KLLSketchState:
     def deserialize(data: tuple) -> "KLLSketchState":
         sketch_size, shrinking_factor, count, buffers = data[:4]
         rng_count = data[4] if len(data) > 4 else 0
+        # deequ-lint: ignore[host-fetch] -- serde: buffers are host lists from decoded state
         compactors = [np.array(buf, dtype=np.float64) for buf in buffers]
         if not compactors:
             compactors = [np.empty(0, dtype=np.float64)]
@@ -208,6 +210,7 @@ class KLLSketchState:
         """Rebuild from BucketDistribution.data/.parameters
         (analogue of QuantileNonSample.reconstruct, reference L46-60)."""
         shrinking_factor, sketch_size = parameters
+        # deequ-lint: ignore[host-fetch] -- serde: raw_buffers are host lists from decoded state
         compactors = [np.array(buf, dtype=np.float64) for buf in raw_buffers]
         count = sum(len(b) * (2 ** i) for i, b in enumerate(compactors))
         return KLLSketchState(int(sketch_size), float(shrinking_factor), compactors, count)

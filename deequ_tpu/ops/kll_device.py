@@ -160,7 +160,9 @@ def fold_summaries(
     compactor entries, then one standard compaction bounds the size. The
     result obeys the normal KLL merge algebra (mergeable with host-built
     and persisted sketches)."""
+    # deequ-lint: ignore[host-fetch] -- gathered summaries were drained (and fetch-accounted) before this host-side fold
     items = np.asarray(items, dtype=np.float64).ravel()
+    # deequ-lint: ignore[host-fetch] -- gathered summaries were drained (and fetch-accounted) before this host-side fold
     weights = np.asarray(weights, dtype=np.float64).ravel()
     on = weights > 0
     if not on.any():

@@ -295,6 +295,13 @@ class ScanStats:
         self.mesh_stragglers = 0
         self.peer_losses = 0
         self.unverified_row_ranges = []
+        # static plan lint (deequ_tpu/lint/plan_lint.py, armed via
+        # run_scan(plan_lint=...) / DEEQU_TPU_PLAN_LINT): finding rows
+        # the jaxpr pass produced for this process's scans, and how many
+        # actual lint TRACES ran — memoization means repeated scans of an
+        # identical plan add zero traces (the bench memoization assert)
+        self.plan_lints = []
+        self.plan_lint_traces = 0
 
     def snapshot(self) -> dict:
         snap = dict(self.__dict__)
@@ -304,6 +311,7 @@ class ScanStats:
         snap["unverified_row_ranges"] = [
             tuple(r) for r in self.unverified_row_ranges
         ]
+        snap["plan_lints"] = [dict(f) for f in self.plan_lints]
         return snap
 
     def record_unverified(self, start: int, stop: int, reason: str) -> dict:
@@ -1378,6 +1386,7 @@ def fetch_deferred(scans: Sequence["DeferredScan"]) -> None:
     def _dev_key(a):
         try:
             return tuple(sorted(str(d) for d in a.devices()))
+        # deequ-lint: ignore[bare-except] -- device-placement probe on maybe-non-jax arrays; absence of .devices() IS the answer
         except Exception:  # noqa: BLE001 — non-jax array
             return None
 
@@ -1460,6 +1469,62 @@ def _record_kernel_passes(plan_ir, chunks: int) -> None:
         SCAN_STATS.device_select_passes += plan_ir.select_ops * chunks
 
 
+def _maybe_plan_lint(
+    plan_ir,
+    raw_flat,
+    args,
+    lut_arrays,
+    prog_key,
+    packer,
+    mesh,
+    mode: str,
+    fallback: bool = False,
+) -> None:
+    """Static plan lint (deequ_tpu/lint/plan_lint.py): trace the fused
+    flat step to a jaxpr and check the IR against the contracts the plan
+    declares — BEFORE the first dispatch of the attempt, so a
+    planner/packer drift (a sort primitive inside a selection-variant
+    plan, a mis-tagged fold leaf) is rejected as a typed
+    ``PlanLintError`` while the program is still just IR.
+
+    Memoized alongside the FULL program identity — the same
+    (prog_key, packer layout, mesh) triple `_global_prog_key` uses for
+    the cross-table program cache, plus variant and backend leg — so a
+    program rebuilt under a different packer layout lints fresh instead
+    of inheriting another layout's verdict, and enforcement still costs
+    one trace per (plan, kernel-variant). Dictionary-baked programs
+    (table-specific constants in the trace) skip memoization entirely,
+    mirroring their exclusion from the program cache. Each attempt of
+    the fault ladder re-enters here with ITS plan, which is exactly the
+    re-lint the ladder's re-planning needs (an OOM-mid-selection retry
+    lints under the sort variant's contract, the CPU fallback re-jit
+    lints once on its own key)."""
+    if mode == "off" or not args:
+        return
+    from deequ_tpu.lint.plan_lint import enforce_plan_lint, lint_plan_cached
+
+    avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+    memo_key = None
+    baked = any(op.dictionary_baked for op in plan_ir.ops)
+    if prog_key is not None and not baked:
+        global_key = _global_prog_key(prog_key, packer, mesh)
+        if global_key is not None:
+            memo_key = (
+                global_key,
+                plan_ir.variant,
+                plan_ir.fold_tags,
+                bool(fallback),
+            )
+    findings, traced = lint_plan_cached(
+        plan_ir, lambda *a: raw_flat(*a, lut_arrays), avals, memo_key
+    )
+    if traced:
+        SCAN_STATS.plan_lint_traces += 1
+    if findings:
+        SCAN_STATS.plan_lints.extend(f.as_dict() for f in findings)
+    enforce_plan_lint(findings, mode)
+
+
 def _block_throttle(arr) -> None:
     """Wait for a device result WITHOUT fetching it (pipeline
     backpressure for the device-fold loops). The wait is a drain in the
@@ -1481,6 +1546,7 @@ def _cpu_fallback_device():
     confusing secondary backend-lookup failure."""
     try:
         return jax.devices("cpu")[0]
+    # deequ-lint: ignore[bare-except] -- backend-registration probe: no CPU backend is a valid state, not a device fault
     except Exception:  # noqa: BLE001 — backend not registered
         return None
 
@@ -1521,6 +1587,7 @@ def run_scan(
     window: Optional[int] = None,
     shard_deadline: Optional[float] = None,
     select_kernel: Optional[bool] = None,
+    plan_lint: Optional[str] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1585,10 +1652,23 @@ def run_scan(
     kernel). ``select_kernel=False`` / DEEQU_TPU_SELECT_KERNEL=0 keeps
     the sort path everywhere — the A/B + regression-triage escape hatch.
 
+    ``plan_lint`` (``"error"`` | ``"warn"`` | ``"off"``; default from
+    ``DEEQU_TPU_PLAN_LINT``, default off) arms the STATIC plan lint
+    (deequ_tpu/lint): each attempt's fused program is traced to a jaxpr
+    and checked against the plan's declared contracts before dispatch —
+    a selection-variant plan containing a ``sort`` primitive, a host
+    callback inside the fused program, or a mis-tagged fold leaf raises
+    a typed ``PlanLintError`` (``"error"``) or warns
+    (``PlanLintWarning``). Findings land on ``SCAN_STATS.plan_lints``;
+    results are memoized with the program cache so the lint costs one
+    trace per (plan, kernel-variant), observable via
+    ``SCAN_STATS.plan_lint_traces``.
+
     ``defer=True`` scans dispatch under the same typed boundaries, but
     errors surfacing at ``result()`` are past bisection/fallback — the
     caller holds the only retry point then.
     """
+    from deequ_tpu.lint.plan_lint import plan_lint_mode
     from deequ_tpu.ops.scan_plan import select_kernel_enabled
 
     if on_device_error not in ("fail", "fallback"):
@@ -1599,6 +1679,9 @@ def run_scan(
     # resolve (and validate) the selection-kernel switch ONCE per run so
     # every bisection/reshard attempt plans against the same setting
     select_kernel = select_kernel_enabled(select_kernel)
+    # same for the plan-lint mode: every attempt of the fault ladder
+    # lints (or doesn't) under one resolved setting
+    plan_lint = plan_lint_mode(plan_lint)
     if mesh is None:
         mesh = current_mesh()
     if device_deadline is None:
@@ -1630,6 +1713,7 @@ def run_scan(
             table, ops, chunk_rows, mesh,
             scan_id=scan_id, device_deadline=stream_deadline,
             window=window, select_kernel=select_kernel,
+            plan_lint=plan_lint,
         )
 
     chunk_override = chunk_rows
@@ -1750,12 +1834,12 @@ def run_scan(
                     return _run_scan_once(
                         table, ops, chunk_override, None, defer,
                         None, scan_ctx, report, window,
-                        select_kernel=select_kernel,
+                        select_kernel=select_kernel, plan_lint=plan_lint,
                     )
             result = _run_scan_once(
                 table, ops, chunk_override, mesh, defer,
                 attempt_deadline, scan_ctx, report, window,
-                select_kernel=select_kernel,
+                select_kernel=select_kernel, plan_lint=plan_lint,
             )
             DEVICE_HEALTH.record_success()
             if n_dev > 1:
@@ -1858,6 +1942,7 @@ def _run_scan_once(
     report: Dict[str, Any],
     window: int = DEFAULT_SCAN_WINDOW,
     select_kernel: bool = True,
+    plan_lint: str = "off",
 ) -> List[Any]:
     """One attempt of the fused in-memory scan (the pre-fault-tolerance
     run_scan body, instrumented at the three device boundaries).
@@ -2007,6 +2092,14 @@ def _run_scan_once(
     if cache is not None:
         SCAN_STATS.resident_passes += 1
         SCAN_STATS.bytes_resident += cache.nbytes
+        # static plan lint BEFORE any dispatch (including the fused
+        # stack allocation): the resident chunks supply the arg shapes
+        if cache.device_chunks:
+            _maybe_plan_lint(
+                plan_ir, raw_flat, cache.device_chunks[0], lut_arrays,
+                prog_key, packer, mesh, plan_lint,
+                fallback=bool(scan_ctx.get("fallback")),
+            )
 
         def ensure_shapes(args):
             if folder.shapes is None:
@@ -2117,6 +2210,14 @@ def _run_scan_once(
             stop = min(start + chunk, n_rows)
             args = packer.pack(start, stop)
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
+            if ci == 0:
+                # static plan lint on the first chunk's shapes, before
+                # its transfer/dispatch (memoized per program identity)
+                _maybe_plan_lint(
+                    plan_ir, raw_flat, args, lut_arrays,
+                    prog_key, packer, mesh, plan_lint,
+                    fallback=bool(scan_ctx.get("fallback")),
+                )
             if folder.shapes is None:
                 folder.shapes = device_call(
                     lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
@@ -2472,6 +2573,7 @@ def _prefetch(iterator, depth: int = 2):
                     break
                 except queue.Full:
                     continue
+        # deequ-lint: ignore[bare-except] -- prefetch reader forwards the exception to the consumer via the queue, re-raised there
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer
             # same stop-checked retry as items: a single timed put could
             # drop the exception while the consumer is busy packing a
@@ -2556,6 +2658,7 @@ def _run_scan_stream(
     device_deadline: Optional[float] = None,
     window: int = DEFAULT_SCAN_WINDOW,
     select_kernel: bool = True,
+    plan_lint: str = "off",
 ) -> List[Any]:
     """One fused pass over a StreamingTable: batches stream off storage on
     a reader thread, pack into fixed-size chunks, and dispatch with a small
@@ -2639,6 +2742,12 @@ def _run_scan_stream(
     # either changes (layout upgrades are sticky; LUT shapes change only
     # when a batch dictionary crosses a pow2 size bucket)
     current_prog: Optional[tuple] = None  # (sig, step_fn, shapes, raw_flat)
+    # program signatures already plan-linted THIS scan: a mid-stream
+    # layout upgrade rebuilds the program under a new signature and must
+    # re-lint it (dictionary-baked per-batch retraces under an UNCHANGED
+    # signature share one structural lint — the baked constants differ,
+    # the traced contract surface does not)
+    linted_sigs: set = set()
 
     import time as _time
 
@@ -2699,6 +2808,15 @@ def _run_scan_stream(
             stop = min(start + chunk, n)
             args = packer.pack(start, stop)
             SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
+            if sig not in linted_sigs:
+                # static plan lint before this program's first
+                # transfer/dispatch — runs again after a mid-stream
+                # layout upgrade (new sig = new traced program)
+                _maybe_plan_lint(
+                    plan_ir, raw_flat, args, lut_arrays,
+                    prog_key, packer, mesh, plan_lint,
+                )
+                linted_sigs.add(sig)
             if shapes is None:
                 shapes = device_call(
                     lambda: jax.eval_shape(shape_fn, *args, lut_arrays),

@@ -40,6 +40,13 @@ import os
 from dataclasses import dataclass, replace
 from typing import Optional, Sequence, Tuple
 
+import jax
+
+#: the reduction tags the fold layer knows how to merge (scan_engine's
+#: _tag_reduce_np / _DeviceFoldPlan); a declared tag outside this set is
+#: a planner bug the plan lint rejects before dispatch
+KNOWN_FOLD_TAGS = frozenset(("sum", "min", "max", "gather"))
+
 
 def select_kernel_enabled(param: Optional[bool] = None) -> bool:
     """Resolve the selection-kernel switch: explicit argument wins, then
@@ -63,19 +70,43 @@ def select_kernel_enabled(param: Optional[bool] = None) -> bool:
 
 @dataclass(frozen=True)
 class ScanPlan:
-    """One attempt's resolved op list + kernel census.
+    """One attempt's resolved op list + kernel census + declared contracts.
 
     ``ops`` are the concrete ScanOps the executor traces (variant
     substitutions applied, cache keys rewritten so traced-program caches
     can never serve a sort-path program to a selection-path scan or vice
     versa). ``sort_ops``/``select_ops`` count ops per chunk dispatch that
     run a device sort / a histogram selection — the executor multiplies
-    by chunks processed into ScanStats."""
+    by chunks processed into ScanStats.
+
+    The remaining fields are the plan's DECLARED contracts — the metadata
+    the static plan lint (deequ_tpu/lint/plan_lint.py) checks the traced
+    jaxpr against, so a planner/packer drift is caught at trace time
+    instead of after a bench run:
+
+    - ``variant`` — ``"select"`` (every summary op routed through the
+      histogram selection kernel: the traced program must contain ZERO
+      ``sort`` primitives, the static twin of the
+      ``device_select_passes``/``device_sort_passes`` runtime pair),
+      ``"sort"`` (sort path), ``"mixed"`` (both kernels present), or
+      ``"none"`` (no summary kernels at all);
+    - ``fold_tags`` — per resolved op, the tuple of reduction-tag leaves
+      the planner declares for the fold layer; the lint re-derives the
+      actual leaves from ``ops[i].tags`` and rejects any disagreement (an
+      ``add``-declared leaf actually merged with ``max`` silently
+      corrupts every cross-chunk merge);
+    - ``fetch_contract`` — ``"one-fetch"`` when every op is
+      device-foldable (the whole scan pays one device->host fetch) else
+      ``"per-chunk"``; traced programs must contain no host callbacks
+      either way."""
 
     ops: Tuple
     resident: bool
     select_ops: int = 0
     sort_ops: int = 0
+    variant: str = "none"
+    fold_tags: Tuple[Tuple[str, ...], ...] = ()
+    fetch_contract: str = "per-chunk"
 
 
 def _selectable(op, packer) -> bool:
@@ -119,9 +150,27 @@ def plan_scan_ops(
             resolved.append(op)
             if op.sorts_chunk:
                 n_sort += 1
+    if n_select and not n_sort:
+        variant = "select"
+    elif n_sort and not n_select:
+        variant = "sort"
+    elif n_sort and n_select:
+        variant = "mixed"
+    else:
+        variant = "none"
     return ScanPlan(
         ops=tuple(resolved),
         resident=resident,
         select_ops=n_select,
         sort_ops=n_sort,
+        variant=variant,
+        fold_tags=tuple(
+            tuple(str(t) for t in jax.tree.leaves(op.tags))
+            for op in resolved
+        ),
+        fetch_contract=(
+            "one-fetch"
+            if all(op.compact is None for op in resolved)
+            else "per-chunk"
+        ),
     )

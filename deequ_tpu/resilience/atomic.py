@@ -98,6 +98,7 @@ def atomic_write_bytes(
     except BaseException:
         try:
             fs.delete(tmp)
+        # deequ-lint: ignore[bare-except] -- best-effort tmp-file cleanup after the durable write already succeeded/failed typed
         except Exception:  # noqa: BLE001 — best-effort cleanup
             pass
         raise

@@ -197,6 +197,7 @@ class StreamCheckpointer:
                 retry=self._retry,
                 what=f"checkpoint at batch {ckpt.batch_index}",
             )
+        # deequ-lint: ignore[bare-except] -- checkpointing is best-effort by contract: a failed save is COUNTED (save_failures) and the stream continues
         except Exception:  # noqa: BLE001 — checkpointing is best-effort
             self.save_failures += 1
             return False
@@ -207,11 +208,13 @@ class StreamCheckpointer:
     def _prune(self) -> None:
         try:
             names = sorted(self._list())
+        # deequ-lint: ignore[bare-except] -- pruning is housekeeping; an unlistable store must not fail the run
         except Exception:  # noqa: BLE001 — pruning is housekeeping only
             return
         for stale in names[: max(len(names) - self.keep, 0)]:
             try:
                 self._fs.delete(self._fs.join(self.directory, stale))
+            # deequ-lint: ignore[bare-except] -- stale checkpoint files are harmless; deletion is best-effort
             except Exception:  # noqa: BLE001 — stale files are harmless
                 pass
 
@@ -222,6 +225,7 @@ class StreamCheckpointer:
         store that cannot even be LISTED degrades the same way."""
         try:
             names = sorted(self._list(), reverse=True)
+        # deequ-lint: ignore[bare-except] -- unreachable store degrades to a fresh run (documented load_latest contract)
         except Exception:  # noqa: BLE001 — unreachable store: start fresh
             return None
         for name in names:
@@ -231,6 +235,7 @@ class StreamCheckpointer:
                     self._fs, path, f"checkpoint {name}", retry=self._retry
                 )
                 found_fp, ckpt = _decode(payload, f"checkpoint {name}")
+            # deequ-lint: ignore[bare-except] -- damaged checkpoints fall back to older ones; CorruptStateException is typed upstream
             except Exception:  # noqa: BLE001 — damaged checkpoint: fall back
                 continue
             if found_fp != fingerprint:
@@ -243,11 +248,13 @@ class StreamCheckpointer:
         run of this directory starts fresh)."""
         try:
             names = self._list()
+        # deequ-lint: ignore[bare-except] -- unreachable store means nothing to clear; best-effort
         except Exception:  # noqa: BLE001 — unreachable store: nothing kept
             return
         for name in names:
             try:
                 self._fs.delete(self._fs.join(self.directory, name))
+            # deequ-lint: ignore[bare-except] -- per-file deletion during clear() is best-effort
             except Exception:  # noqa: BLE001
                 pass
 

@@ -117,6 +117,7 @@ def _quarantinable(exc: BaseException) -> bool:
         import pyarrow as pa
 
         return isinstance(exc, pa.lib.ArrowException)
+    # deequ-lint: ignore[bare-except] -- optional-dependency probe (pyarrow), not a device seam
     except Exception:  # noqa: BLE001 — pyarrow absent: nothing to match
         return False
 

@@ -63,7 +63,16 @@ class VerificationResult:
       degraded multi-host run (``on_peer_loss="degrade"``) completed
       WITHOUT verifying: the lost hosts' shards. Non-empty means the
       run's metrics cover a strict subset of the dataset — check statuses
-      hold only for the verified rows."""
+      hold only for the verified rows.
+
+    Static analysis rides the same reporting surface:
+
+    - ``plan_lints`` — the plan-lint finding rows
+      (deequ_tpu/lint/plan_lint.py) this run's scans produced when the
+      lint is armed (``DEEQU_TPU_PLAN_LINT=warn|error``): each row is
+      ``{rule, severity, message, location}``. Empty on a healthy run —
+      ``"error"`` mode raises typed ``PlanLintError`` pre-dispatch
+      instead of completing with error findings."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
@@ -76,6 +85,7 @@ class VerificationResult:
     mesh_events: List[dict] = field(default_factory=list)
     resharded: bool = False
     unverified_row_ranges: List[tuple] = field(default_factory=list)
+    plan_lints: List[dict] = field(default_factory=list)
 
     @staticmethod
     def success_metrics_as_rows(
@@ -230,6 +240,7 @@ class VerificationSuite:
         events_before = len(SCAN_STATS.degradation_events)
         fallback_before = SCAN_STATS.fallback_scans
         unverified_before = len(SCAN_STATS.unverified_row_ranges)
+        lints_before = len(SCAN_STATS.plan_lints)
         scan_before = {
             k: getattr(SCAN_STATS, k)
             for k in (
@@ -306,6 +317,9 @@ class VerificationSuite:
         result.unverified_row_ranges = [
             tuple(r)
             for r in SCAN_STATS.unverified_row_ranges[unverified_before:]
+        ]
+        result.plan_lints = [
+            dict(f) for f in SCAN_STATS.plan_lints[lints_before:]
         ]
         if SCAN_STATS.fallback_scans > fallback_before:
             result.fallback_backend = SCAN_STATS.fallback_backend

@@ -100,4 +100,21 @@ def test_multihost_cross_process_state_merge():
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import __graft_entry__ as g
 
-    g.dryrun_multihost(2, devices_per_process=2)
+    try:
+        g.dryrun_multihost(2, devices_per_process=2)
+    except RuntimeError as e:
+        # some jax builds ship a CPU backend without multiprocess
+        # collectives: the cross-process all_gather (the one DCN-tier
+        # exchange this test exists to execute) raises INVALID_ARGUMENT
+        # in every worker. That is a missing-capability condition of the
+        # build, not a regression in the merge path — skip with the
+        # detected signature so a REAL merge failure still fails loudly.
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip(
+                "this jax build lacks CPU multiprocess collectives "
+                "(cross-process all_gather raises INVALID_ARGUMENT: "
+                "'Multiprocess computations aren't implemented on the "
+                "CPU backend'); the multi-host exchange needs a real "
+                "multi-host backend"
+            )
+        raise

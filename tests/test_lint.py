@@ -1,0 +1,773 @@
+"""Static-analysis suite (deequ_tpu/lint): the jaxpr plan lint and the
+AST repo lint.
+
+Plan-lint pins:
+
+- every healthy tier-1 scan shape (resident fused, non-resident,
+  streaming, sharded mesh, single-device) passes ``plan_lint="error"``
+  with ZERO findings;
+- a selection-variant plan whose traced program contains a ``sort``
+  primitive is rejected as a typed ``PlanLintError`` BEFORE dispatch
+  (the static twin of the zero-sort runtime contract);
+- a deliberately mis-tagged fold leaf (planner metadata disagreeing with
+  the op's registered reduction tags) raises typed, pre-dispatch;
+- the fault ladder composes: an OOM injected mid-selection re-plans onto
+  the sort path and the re-lint runs under the SORT variant's contract
+  (no false zero-sort violation); the CPU-fallback re-jit is linted
+  exactly once more;
+- lint results memoize with the program identity: a second scan of an
+  identical plan adds zero lint traces.
+
+Repo-lint pins: each rule fires on a minimal violation, respects
+scoping and the ``# deequ-lint: ignore[rule] -- reason`` suppression
+syntax (reason REQUIRED), and the shipped codebase itself is
+zero-finding (the CI gate ``python -m deequ_tpu.lint``).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deequ_tpu.analyzers import ApproxQuantile, Completeness, Mean, Size
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.data.streaming import stream_table
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.exceptions import PlanLintError, PlanLintWarning
+from deequ_tpu.lint import (
+    LintFinding,
+    clear_lint_memo,
+    lint_paths,
+    lint_plan,
+    lint_source,
+    plan_lint_mode,
+)
+from deequ_tpu.ops import scan_plan as scan_plan_module
+from deequ_tpu.ops.device_policy import DEVICE_HEALTH
+from deequ_tpu.ops.scan_engine import (
+    SCAN_STATS,
+    install_scan_fault_hook,
+    run_scan,
+)
+from deequ_tpu.ops.scan_plan import ScanPlan, plan_scan_ops
+from deequ_tpu.parallel.mesh import use_mesh
+from deequ_tpu.resilience import FaultInjectingScanHook
+from deequ_tpu.verification import VerificationSuite
+from deequ_tpu.checks import Check, CheckLevel
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _table(n=4096, cols=2):
+    rng = np.random.default_rng(11)
+    return ColumnarTable(
+        [
+            Column(
+                f"c{i}",
+                DType.FRACTIONAL,
+                values=rng.normal(size=n),
+                mask=np.ones(n, dtype=np.bool_),
+            )
+            for i in range(cols)
+        ]
+    )
+
+
+def _analyzers():
+    return [Size(), Completeness("c0"), Mean("c1"), ApproxQuantile("c0", 0.5)]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lint_memo():
+    clear_lint_memo()
+    yield
+    clear_lint_memo()
+
+
+@pytest.fixture
+def lint_error_env(monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_PLAN_LINT", "error")
+    yield
+
+
+# -- mode resolution ----------------------------------------------------
+
+
+def test_plan_lint_mode_resolution(monkeypatch):
+    monkeypatch.delenv("DEEQU_TPU_PLAN_LINT", raising=False)
+    assert plan_lint_mode() == "off"
+    assert plan_lint_mode("warn") == "warn"
+    monkeypatch.setenv("DEEQU_TPU_PLAN_LINT", "error")
+    assert plan_lint_mode() == "error"
+    assert plan_lint_mode("off") == "off"  # explicit argument wins
+
+
+def test_plan_lint_mode_validation(monkeypatch):
+    with pytest.raises(ValueError, match="plan_lint"):
+        run_scan(_table(64), [], plan_lint="loud")
+    monkeypatch.setenv("DEEQU_TPU_PLAN_LINT", "bogus")
+    with pytest.raises(ValueError, match="DEEQU_TPU_PLAN_LINT"):
+        plan_lint_mode()
+
+
+# -- plan lint: healthy paths are clean ---------------------------------
+
+
+def test_resident_selection_path_clean_at_error(lint_error_env):
+    table = _table().persist()
+    ctx = AnalysisRunner.do_analysis_run(table, _analyzers())
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.plan_lints == []
+    assert SCAN_STATS.plan_lint_traces >= 1
+    # the resident path actually ran the selection variant
+    assert SCAN_STATS.device_select_passes > 0
+    assert SCAN_STATS.device_sort_passes == 0
+
+
+def test_nonresident_and_streaming_paths_clean_at_error(lint_error_env):
+    ctx = AnalysisRunner.do_analysis_run(_table(), _analyzers())
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    ctx = AnalysisRunner.do_analysis_run(
+        stream_table(_table(), 1024), _analyzers()
+    )
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.plan_lints == []
+
+
+def test_single_device_path_clean_at_error(lint_error_env):
+    with use_mesh(None):
+        table = _table().persist()
+        ctx = AnalysisRunner.do_analysis_run(table, _analyzers())
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.plan_lints == []
+
+
+def test_lint_memoized_second_scan_adds_zero_traces(lint_error_env):
+    table = _table().persist()
+    AnalysisRunner.do_analysis_run(table, _analyzers())
+    traces = SCAN_STATS.plan_lint_traces
+    assert traces >= 1
+    AnalysisRunner.do_analysis_run(table, _analyzers())
+    assert SCAN_STATS.plan_lint_traces == traces
+
+
+def test_verification_result_carries_plan_lints(lint_error_env):
+    result = (
+        VerificationSuite.on_data(_table())
+        .add_check(Check(CheckLevel.ERROR, "lint").has_size(lambda n: n > 0))
+        .run()
+    )
+    assert result.plan_lints == []
+
+
+# -- plan lint: drift rejection (typed, pre-dispatch) -------------------
+
+
+def _sorting_drift(monkeypatch):
+    """Simulate planner/packer drift: the resolved selection variant's
+    update smuggles a device sort into the traced program while the plan
+    still declares variant='select'."""
+    real = plan_scan_ops
+
+    def drifted(ops, packer=None, resident=False, select_kernel=None):
+        plan = real(ops, packer, resident, select_kernel)
+        if plan.variant != "select":
+            return plan
+        new_ops = []
+        for op in plan.ops:
+            def sorting_update(vals, row_valid, xp, local_n, _u=op.update):
+                out = _u(vals, row_valid, xp, local_n)
+                probe = xp.sort(
+                    xp.where(row_valid, 1.0, 0.0)
+                )[0] * 0.0
+                return jax.tree.map(lambda leaf: leaf + probe, out)
+
+            new_ops.append(replace(op, update=sorting_update))
+        return replace(plan, ops=tuple(new_ops))
+
+    monkeypatch.setattr(scan_plan_module, "plan_scan_ops", drifted)
+
+
+def test_select_variant_with_sort_primitive_rejected(monkeypatch):
+    _sorting_drift(monkeypatch)
+    table = _table().persist()
+    ops = [a.scan_op(table) for a in _analyzers() if hasattr(a, "scan_op")]
+    with pytest.raises(PlanLintError) as exc_info:
+        run_scan(table, ops, plan_lint="error")
+    assert any(
+        f.rule == "plan-select-sort" for f in exc_info.value.findings
+    )
+    # rejected BEFORE dispatch: nothing ran
+    assert SCAN_STATS.chunks_processed == 0
+    assert SCAN_STATS.device_fetches == 0
+
+
+def test_mis_tagged_fold_leaf_rejected_pre_dispatch(monkeypatch):
+    real = plan_scan_ops
+
+    def mistagged(ops, packer=None, resident=False, select_kernel=None):
+        plan = real(ops, packer, resident, select_kernel)
+        corrupted = tuple(
+            tuple("max" if t == "sum" else t for t in tags)
+            for tags in plan.fold_tags
+        )
+        return replace(plan, fold_tags=corrupted)
+
+    monkeypatch.setattr(scan_plan_module, "plan_scan_ops", mistagged)
+    table = _table()
+    ops = [a.scan_op(table) for a in _analyzers() if hasattr(a, "scan_op")]
+    with pytest.raises(PlanLintError) as exc_info:
+        run_scan(table, ops, plan_lint="error")
+    assert any(f.rule == "plan-fold-tag" for f in exc_info.value.findings)
+    assert SCAN_STATS.chunks_processed == 0
+
+
+def test_plan_lint_error_raises_through_verification_suite(
+    monkeypatch, lint_error_env
+):
+    """The error-mode contract holds at the FLAGSHIP surface (review
+    round): a drifted plan raises typed PlanLintError through
+    AnalysisRunner/VerificationSuite instead of being swallowed into
+    per-analyzer failure metrics — planner drift is a programming
+    error, not a data-quality finding."""
+    _sorting_drift(monkeypatch)
+    table = _table().persist()
+    with pytest.raises(PlanLintError):
+        (
+            VerificationSuite.on_data(table)
+            .add_check(
+                Check(CheckLevel.ERROR, "drift").has_approx_quantile(
+                    "c0", 0.5, lambda v: True
+                )
+            )
+            .run()
+        )
+
+
+def test_plan_lint_error_raises_through_streaming_runner(
+    monkeypatch, lint_error_env
+):
+    """The typed raise survives the streaming runner's per-batch fold
+    traps too (review round): a mis-tagged plan on a stream raises,
+    never lands as a failure metric."""
+    real = plan_scan_ops
+
+    def mistagged(ops, packer=None, resident=False, select_kernel=None):
+        plan = real(ops, packer, resident, select_kernel)
+        corrupted = tuple(
+            tuple("max" if t == "sum" else t for t in tags)
+            for tags in plan.fold_tags
+        )
+        return replace(plan, fold_tags=corrupted)
+
+    monkeypatch.setattr(scan_plan_module, "plan_scan_ops", mistagged)
+    with pytest.raises(PlanLintError):
+        AnalysisRunner.do_analysis_run(
+            stream_table(_table(), 1024), [Mean("c0"), Completeness("c0")]
+        )
+
+
+def test_warn_mode_surfaces_findings_and_completes(monkeypatch):
+    _sorting_drift(monkeypatch)
+    table = _table().persist()
+    ops = [a.scan_op(table) for a in _analyzers() if hasattr(a, "scan_op")]
+    with pytest.warns(PlanLintWarning):
+        run_scan(table, ops, plan_lint="warn")
+    assert any(
+        f["rule"] == "plan-select-sort" for f in SCAN_STATS.plan_lints
+    )
+    # warn mode surfaces, never blocks: the scan ran
+    assert SCAN_STATS.chunks_processed > 0
+
+
+def test_off_mode_skips_lint_entirely(monkeypatch):
+    _sorting_drift(monkeypatch)
+    table = _table().persist()
+    ops = [a.scan_op(table) for a in _analyzers() if hasattr(a, "scan_op")]
+    run_scan(table, ops, plan_lint="off")
+    assert SCAN_STATS.plan_lint_traces == 0
+    assert SCAN_STATS.plan_lints == []
+
+
+# -- plan lint: fault-ladder composition --------------------------------
+
+
+def test_oom_mid_selection_relints_under_sort_contract(lint_error_env):
+    """An OOM injected during the resident selection pass evicts
+    residency; the bisected retry re-plans onto the SORT path, whose
+    re-lint must run under the sort variant's contract — the sort
+    primitive it legitimately contains is NOT a finding."""
+    table = _table().persist()
+    DEVICE_HEALTH.reset()
+    hook = FaultInjectingScanHook(faults={0: ("oom", 1)})
+    prev = install_scan_fault_hook(hook)
+    try:
+        ctx = AnalysisRunner.do_analysis_run(table, _analyzers())
+    finally:
+        install_scan_fault_hook(prev)
+        DEVICE_HEALTH.reset()
+    assert hook.injected, "fault hook never fired"
+    assert SCAN_STATS.oom_bisections >= 1
+    assert SCAN_STATS.device_sort_passes > 0  # re-planned onto sort
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.plan_lints == []
+    # both variants were linted (selection attempt + sort re-plan)
+    assert SCAN_STATS.plan_lint_traces >= 2
+
+
+def test_cpu_fallback_rejit_linted_once(lint_error_env):
+    """A persistent device loss with on_device_error='fallback' re-jits
+    on the CPU backend: the fallback attempt's plan is linted exactly
+    once more (its own memo key), and stays clean."""
+    table = _table().persist()
+    DEVICE_HEALTH.reset()
+    hook = FaultInjectingScanHook(faults={0: ("lost", 99)})
+    prev = install_scan_fault_hook(hook)
+    try:
+        ctx = AnalysisRunner.do_analysis_run(
+            table, _analyzers(), on_device_error="fallback"
+        )
+    finally:
+        install_scan_fault_hook(prev)
+        DEVICE_HEALTH.reset()
+    assert hook.injected, "fault hook never fired"
+    assert SCAN_STATS.fallback_scans >= 1
+    assert all(m.value.is_success for m in ctx.all_metrics())
+    assert SCAN_STATS.plan_lints == []
+    traces = SCAN_STATS.plan_lint_traces
+    assert traces >= 2
+    # a repeat of the same degraded run re-uses every memoized result
+    DEVICE_HEALTH.reset()
+    hook2 = FaultInjectingScanHook(faults={0: ("lost", 99)})
+    prev = install_scan_fault_hook(hook2)
+    try:
+        AnalysisRunner.do_analysis_run(
+            table.persist(), _analyzers(), on_device_error="fallback"
+        )
+    finally:
+        install_scan_fault_hook(prev)
+        DEVICE_HEALTH.reset()
+    assert SCAN_STATS.plan_lint_traces == traces
+
+
+# -- plan lint: direct rule units ---------------------------------------
+
+
+def _fake_plan(variant="select", fold_tags=(), ops=()):
+    return ScanPlan(
+        ops=tuple(ops),
+        resident=True,
+        select_ops=1 if variant == "select" else 0,
+        sort_ops=0 if variant == "select" else 1,
+        variant=variant,
+        fold_tags=tuple(fold_tags),
+        fetch_contract="one-fetch",
+    )
+
+
+def test_lint_plan_flags_callback_primitives():
+    def with_callback(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+
+    findings = lint_plan(
+        _fake_plan(variant="none"),
+        with_callback,
+        (jax.ShapeDtypeStruct((8,), np.float64),),
+    )
+    assert any(f.rule == "plan-host-callback" for f in findings)
+
+
+def test_lint_plan_sort_rule_scoped_to_select_variant():
+    sorter = lambda x: jnp.sort(x)  # noqa: E731
+    avals = (jax.ShapeDtypeStruct((8,), np.float64),)
+    select = lint_plan(_fake_plan(variant="select"), sorter, avals)
+    assert any(f.rule == "plan-select-sort" for f in select)
+    sort_path = lint_plan(_fake_plan(variant="sort"), sorter, avals)
+    assert not any(f.rule == "plan-select-sort" for f in sort_path)
+
+
+def test_lint_plan_unknown_tag_is_error():
+    findings = lint_plan(_fake_plan(variant="none", fold_tags=(("sum",),)))
+    # declared one op's tags but zero ops: structural mismatch
+    assert any(f.rule == "plan-fold-tag" for f in findings)
+
+
+# -- repo lint: rule units ----------------------------------------------
+
+
+def _lint_snippet(src, rel="ops/snippet.py", rules=None):
+    return lint_source(textwrap.dedent(src), rel, rules)
+
+
+def test_host_fetch_rule_fires_outside_boundary():
+    findings = _lint_snippet(
+        """
+        import numpy as np
+
+        def leak(arr):
+            return np.asarray(arr)
+        """
+    )
+    assert [f.rule for f in findings] == ["host-fetch"]
+
+
+def test_host_fetch_rule_exempts_accounted_boundaries():
+    findings = _lint_snippet(
+        """
+        import numpy as np
+
+        def drain(arr, stats):
+            host = np.asarray(arr)
+            stats.record_fetch(host.nbytes)
+            return host
+        """
+    )
+    assert findings == []
+
+
+def test_host_fetch_rule_scoped_to_device_modules():
+    src = """
+    import numpy as np
+
+    def fine(arr):
+        return np.asarray(arr)
+    """
+    assert _lint_snippet(src, rel="checks.py") == []
+    assert len(_lint_snippet(src, rel="parallel/x.py")) == 1
+
+
+def test_suppression_requires_reason():
+    with_reason = _lint_snippet(
+        """
+        import numpy as np
+
+        def leak(arr):
+            # deequ-lint: ignore[host-fetch] -- arr is a host list here
+            return np.asarray(arr)
+        """
+    )
+    assert with_reason == []
+    # a reason-less suppression is invalid: it suppresses NOTHING (the
+    # violation still reports — a --rules subset run must not hide it)
+    # and is itself a finding
+    without = _lint_snippet(
+        """
+        import numpy as np
+
+        def leak(arr):
+            # deequ-lint: ignore[host-fetch]
+            return np.asarray(arr)
+        """
+    )
+    assert sorted(f.rule for f in without) == [
+        "host-fetch",
+        "suppress-reason",
+    ]
+    subset = _lint_snippet(
+        """
+        import numpy as np
+
+        def leak(arr):
+            # deequ-lint: ignore[host-fetch]
+            return np.asarray(arr)
+        """,
+        rules=["host-fetch"],
+    )
+    assert [f.rule for f in subset] == ["host-fetch"]
+
+
+def test_bare_except_rule():
+    swallows = _lint_snippet(
+        """
+        def f():
+            try:
+                g()
+            except Exception:
+                return None
+        """
+    )
+    assert [f.rule for f in swallows] == ["bare-except"]
+    classified = _lint_snippet(
+        """
+        def f():
+            try:
+                g()
+            except Exception as e:
+                typed = classify_device_error(e, "execute")
+                if typed is not None:
+                    raise typed from e
+                raise
+        """
+    )
+    assert classified == []
+
+
+def test_jit_impure_rule():
+    decorated = _lint_snippet(
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * time.time()
+        """
+    )
+    assert [f.rule for f in decorated] == ["jit-impure"]
+    transitive = _lint_snippet(
+        """
+        import time
+        import jax
+
+        def helper(x):
+            return x + time.monotonic()
+
+        def step(x):
+            return helper(x)
+
+        jitted = jax.jit(step)
+        """
+    )
+    assert [f.rule for f in transitive] == ["jit-impure"]
+    keyed_rng_ok = _lint_snippet(
+        """
+        import jax
+
+        @jax.jit
+        def step(key, x):
+            return x + jax.random.normal(key, x.shape)
+        """
+    )
+    assert keyed_rng_ok == []
+    # ordinary method calls that HAPPEN to be named like transforms
+    # (scanner.scan, checkpointer.checkpoint) must not mark their
+    # function arguments as traced (review round)
+    method_named_ok = _lint_snippet(
+        """
+        import time
+
+        def callback(state):
+            return time.monotonic()
+
+        def drive(scanner, checkpointer):
+            scanner.scan(callback)
+            checkpointer.checkpoint(callback)
+        """
+    )
+    assert method_named_ok == []
+    # ...while the from-import idiom and jax.lax receivers still match
+    lax_ok = _lint_snippet(
+        """
+        import time
+        import jax
+
+        def body(carry, x):
+            return carry + time.time(), None
+
+        def fold(xs):
+            return jax.lax.scan(body, 0.0, xs)
+        """
+    )
+    assert [f.rule for f in lax_ok] == ["jit-impure"]
+
+
+def test_host_fetch_rule_catches_device_conversion_shapes():
+    """The Holt-Winters bug class (review round): float()/iteration over
+    a jax/jnp-rooted expression, .tolist(), np.array — all fetches."""
+    conv = _lint_snippet(
+        """
+        import jax
+
+        def fit(params):
+            return [float(x) for x in jax.nn.sigmoid(params)]
+        """
+    )
+    assert [f.rule for f in conv] == ["host-fetch"]
+    direct = _lint_snippet(
+        """
+        import jax.numpy as jnp
+
+        def peek(x):
+            return float(jnp.sum(x))
+        """
+    )
+    assert [f.rule for f in direct] == ["host-fetch"]
+    tolist = _lint_snippet(
+        """
+        def dump(arr):
+            return arr.tolist()
+        """
+    )
+    assert [f.rule for f in tolist] == ["host-fetch"]
+    nparray = _lint_snippet(
+        """
+        import numpy as np
+
+        def copy(dev):
+            return np.array(dev)
+        """
+    )
+    assert [f.rule for f in nparray] == ["host-fetch"]
+
+
+def test_host_fetch_rule_exempts_jax_host_utilities():
+    """jax.tree.* / jax.devices() return host values — iterating them is
+    not a transfer."""
+    findings = _lint_snippet(
+        """
+        import jax
+
+        def walk(tree):
+            return [t for t in jax.tree.leaves(tree)]
+
+        def names():
+            out = []
+            for d in jax.devices():
+                out.append(str(d))
+            return out
+        """
+    )
+    assert findings == []
+
+
+def test_lint_memo_keys_on_packer_layout(lint_error_env):
+    """Two programs colliding on (op cache keys, chunk, lut sig) but
+    built under DIFFERENT packer layouts must each lint (review round:
+    the memo key shares the program cache's layout component — a
+    differently-shaped program cannot inherit another's verdict)."""
+    n = 2048
+    rng = np.random.default_rng(5)
+    frac = ColumnarTable(
+        [
+            Column(
+                "c0", DType.FRACTIONAL,
+                values=rng.normal(size=n), mask=np.ones(n, bool),
+            )
+        ]
+    )
+    ints = ColumnarTable(
+        [
+            Column(
+                "c0", DType.INTEGRAL,
+                values=rng.integers(0, 100, n), mask=np.ones(n, bool),
+            )
+        ]
+    )
+    analyzers = [Mean("c0"), Completeness("c0")]
+    AnalysisRunner.do_analysis_run(frac, analyzers)
+    traces = SCAN_STATS.plan_lint_traces
+    assert traces >= 1
+    AnalysisRunner.do_analysis_run(ints, analyzers)
+    assert SCAN_STATS.plan_lint_traces > traces, (
+        "a program built under a different packer layout reused the "
+        "other layout's lint verdict"
+    )
+
+
+def test_typed_raise_rule():
+    generic = _lint_snippet(
+        """
+        def f():
+            raise RuntimeError("boom")
+        """
+    )
+    assert [f.rule for f in generic] == ["typed-raise"]
+    precise = _lint_snippet(
+        """
+        def f(x):
+            if x < 0:
+                raise ValueError("x must be >= 0")
+        """
+    )
+    assert precise == []
+
+
+# -- repo lint: the shipped codebase is the fixture ---------------------
+
+
+def test_repo_is_lint_clean():
+    findings = lint_paths()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exits_zero_on_clean_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "deequ_tpu.lint"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_reports_findings_nonzero(tmp_path):
+    bad = tmp_path / "ops"
+    bad.mkdir()
+    (bad / "leak.py").write_text(
+        "import numpy as np\n\ndef f(a):\n    return np.asarray(a)\n"
+    )
+    # a file outside the package root falls back to basename scoping —
+    # lint the snippet through lint_source instead for scope, and use
+    # the CLI only for exit-code plumbing on a generic violation
+    (bad / "raiser.py").write_text(
+        "def f():\n    raise RuntimeError('x')\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "deequ_tpu.lint",
+            str(bad / "leak.py"),
+            "--rules",
+            "jit-impure,suppress-reason",
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0  # out-of-scope rules: no findings
+    proc = subprocess.run(
+        [sys.executable, "-m", "deequ_tpu.lint", "--rules", "nope"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2
+
+
+def test_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "deequ_tpu.lint", "--list-rules"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    for rule in (
+        "host-fetch",
+        "bare-except",
+        "jit-impure",
+        "typed-raise",
+        "suppress-reason",
+    ):
+        assert rule in proc.stdout
+
+
+def test_finding_severity_validated():
+    with pytest.raises(ValueError, match="severity"):
+        LintFinding("x", "fatal", "nope")
