@@ -212,6 +212,104 @@ def measure_config3_selection(n_rows: int):
     }
 
 
+def measure_ingest_overlap(n_batches: int, batch_rows: int):
+    """Columnar-ingest probe (round 8, the config-4/5 ingest-bound
+    shape): ONE streaming analysis over ``n_batches`` dictionary-
+    encodable Parquet files, A/B'd encoded vs raw staging
+    (DEEQU_TPU_ENCODED_INGEST=0). Reports the host->device staging
+    ledger (``bytes_staged``), the double-buffer's overlap fraction, and
+    the encoded-vs-raw byte ratio.
+
+    Contract asserts (the harness refuses to report the probe on
+    violation, like the one-fetch and config-3 asserts): the streaming
+    path must overlap staging with compute (``ingest_overlap_frac > 0``),
+    encoded staging must ship >= 2x fewer bytes than raw on this
+    dictionary-encodable workload, and both runs stay one-fetch."""
+    import os
+    import shutil
+    import tempfile
+
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.io import stream_parquet, write_parquet
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    rng = np.random.default_rng(88)
+    workdir = tempfile.mkdtemp(prefix="deequ_bench_ingest_")
+    analyzers = [Size(), Completeness("v"), Mean("v"), Minimum("v"), Maximum("v")]
+    try:
+        paths = []
+        for b in range(n_batches):
+            vals = (rng.integers(0, 512, batch_rows)).astype(np.float64) * 0.5
+            mask = rng.random(batch_rows) > 0.05
+            path = os.path.join(workdir, f"b{b:03d}.parquet")
+            write_parquet(
+                ColumnarTable(
+                    [Column("v", DType.FRACTIONAL,
+                            values=np.where(mask, vals, 0.0), mask=mask)]
+                ),
+                path,
+            )
+            paths.append(path)
+
+        def run(encoded: bool):
+            prev = os.environ.get("DEEQU_TPU_ENCODED_INGEST")
+            os.environ["DEEQU_TPU_ENCODED_INGEST"] = "1" if encoded else "0"
+            try:
+                SCAN_STATS.reset()
+                t0 = time.time()
+                ctx = AnalysisRunner.do_analysis_run(
+                    stream_parquet(paths, batch_rows=batch_rows), analyzers
+                )
+                wall = time.time() - t0
+            finally:
+                if prev is None:
+                    os.environ.pop("DEEQU_TPU_ENCODED_INGEST", None)
+                else:
+                    os.environ["DEEQU_TPU_ENCODED_INGEST"] = prev
+            assert all(m.value.is_success for m in ctx.all_metrics())
+            return wall, SCAN_STATS.snapshot()
+
+        run(True)   # warmup/compile the encoded streaming program
+        run(False)  # warmup/compile the raw streaming program
+        enc_wall, enc_snap = min(run(True), run(True), key=lambda r: r[0])
+        raw_wall, raw_snap = min(run(False), run(False), key=lambda r: r[0])
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    total = n_batches * batch_rows
+    assert enc_snap["ingest_overlap_frac"] > 0, (
+        "ingest probe violation: the streaming path staged every chunk "
+        "serially (ingest_overlap_frac == 0) — double buffering is dead"
+    )
+    assert enc_snap["bytes_staged"] * 2 <= raw_snap["bytes_staged"], (
+        "ingest probe violation: encoded staging shipped "
+        f"{enc_snap['bytes_staged']} bytes vs raw "
+        f"{raw_snap['bytes_staged']} — the >= 2x reduction contract on "
+        "dictionary-encodable columns is gone"
+    )
+    assert enc_snap["device_fetches"] == 1, (
+        "one-fetch contract regression on the encoded streaming path"
+    )
+    assert raw_snap["device_fetches"] == 1, (
+        "one-fetch contract regression on the raw streaming path"
+    )
+    return {
+        "ingest_stream_rows_per_sec": round(total / max(enc_wall, 1e-9), 1),
+        "ingest_overlap_frac": enc_snap["ingest_overlap_frac"],
+        "bytes_staged_encoded": enc_snap["bytes_staged"],
+        "bytes_staged_raw": raw_snap["bytes_staged"],
+        "encoded_vs_raw_bytes": round(
+            raw_snap["bytes_staged"] / max(enc_snap["bytes_staged"], 1), 3
+        ),
+        "encoded_vs_raw_speedup": round(raw_wall / max(enc_wall, 1e-9), 3),
+        "ingest_effective_mb_per_sec": round(
+            enc_snap["bytes_staged"] / max(enc_wall, 1e-9) / 1e6, 2
+        ),
+    }
+
+
 def measure_plan_lint_overhead(table, analyzers):
     """Static plan-lint cost probe (deequ_tpu/lint) on the resident
     profile scan already warmed by the main bench: ``plan_lint_overhead_ms``
@@ -504,9 +602,16 @@ def main():
     # baseline reuses the compiled program)
     lint_probe = measure_plan_lint_overhead(table, analyzers)
     print(f"plan-lint probe: {lint_probe}", file=sys.stderr)
+    # columnar-ingest probe (round 8): streaming config-5 shape, encoded
+    # vs raw staging + overlap contract
+    ingest_probe = measure_ingest_overlap(
+        n_batches=4 if smoke else 8,
+        batch_rows=SMOKE_ROWS // 4 if smoke else 100_000,
+    )
+    print(f"ingest probe: {ingest_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
-        **lint_probe,
+        **lint_probe, **ingest_probe,
     }
 
     if smoke:

@@ -1,4 +1,10 @@
-from deequ_tpu.data.table import Column, ColumnarTable, DType, Schema
+from deequ_tpu.data.table import (
+    Column,
+    ColumnarTable,
+    ColumnChunk,
+    DType,
+    Schema,
+)
 from deequ_tpu.data.source import (
     BatchSource,
     CSVBatchSource,
@@ -11,6 +17,7 @@ from deequ_tpu.data.streaming import StreamingTable, stream_table
 __all__ = [
     "Column",
     "ColumnarTable",
+    "ColumnChunk",
     "DType",
     "Schema",
     "BatchSource",

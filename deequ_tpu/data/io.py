@@ -113,14 +113,53 @@ def read_parquet(path: str, columns: Optional[Sequence[str]] = None) -> Columnar
     return from_arrow(table)
 
 
+def _from_arrow_dictionary(name: str, combined) -> Optional[Column]:
+    """A Column carrying the ENCODED payload straight off an Arrow
+    DictionaryArray with a numeric value type — no host decode: the
+    indices + dictionary + validity bitmap ride through as a
+    ``ColumnChunk`` (data/table.py) and decode fuses into the scan
+    program. Returns None when the engine should decode instead
+    (non-numeric value type, or cardinality past the int16 code cap —
+    the all-unique fallback)."""
+    import pyarrow as pa
+
+    from deequ_tpu.data.table import MAX_ENCODED_CARDINALITY, ColumnChunk
+
+    value_type = combined.type.value_type
+    if pa.types.is_integer(value_type):
+        dtype, np_dtype = DType.INTEGRAL, np.int64
+    elif pa.types.is_floating(value_type):
+        dtype, np_dtype = DType.FRACTIONAL, np.float64
+    else:
+        return None
+    dictionary = np.asarray(combined.dictionary, dtype=np_dtype)
+    if len(dictionary) > MAX_ENCODED_CARDINALITY:
+        return None
+    mask = ~np.asarray(combined.is_null())
+    codes = np.asarray(combined.indices.fill_null(0))
+    enc = ColumnChunk.from_codes(codes, dictionary, mask=mask)
+    return Column(name, dtype, encoded=enc)
+
+
 def from_arrow(table) -> ColumnarTable:
-    """Convert a pyarrow Table."""
+    """Convert a pyarrow Table. Numeric DictionaryArray columns (Parquet
+    dictionary encoding read with ``read_dictionary``) keep their encoded
+    form — see ``_from_arrow_dictionary`` / docs/ingest.md."""
     import pyarrow as pa
 
     cols = []
     for name, column in zip(table.column_names, table.columns):
         combined = column.combine_chunks()
         pa_type = combined.type
+        if pa.types.is_dictionary(pa_type):
+            encoded = _from_arrow_dictionary(name, combined)
+            if encoded is not None:
+                cols.append(encoded)
+                continue
+            # decode non-encodable dictionaries and fall through to the
+            # plain branches below
+            combined = combined.cast(pa_type.value_type)
+            pa_type = combined.type
         if pa.types.is_integer(pa_type):
             mask = ~np.asarray(combined.is_null())
             values = np.asarray(combined.fill_null(0), dtype=np.int64)

@@ -16,21 +16,38 @@ file metadata, so nothing is read until batches are consumed.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Collection, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from deequ_tpu.data.table import Column, ColumnarTable, DType, Field, Schema
 
-# target host bytes per streamed batch (decoded numpy, before packing)
+# target host bytes per streamed batch (host representation, before packing)
 DEFAULT_BATCH_BYTES = 256 << 20
 
 
-def batch_rows_for_schema(schema: Schema, target_bytes: int = DEFAULT_BATCH_BYTES) -> int:
-    """Rows per batch so a decoded batch is ~target_bytes on host."""
+def batch_rows_for_schema(
+    schema: Schema,
+    target_bytes: int = DEFAULT_BATCH_BYTES,
+    encoded: Collection[str] = (),
+) -> int:
+    """Rows per batch so one batch is ~target_bytes on host.
+
+    ``encoded`` names the columns the source reports as dictionary-
+    encoded: those arrive as int16 codes (+ a tiny dictionary), 2
+    bytes/row, not the 9 bytes/row of a decoded value+mask column —
+    sizing them full-width under-fills batches 2-8x on dictionary-heavy
+    tables (each batch then ships a fraction of the target bytes and the
+    per-batch fixed costs dominate)."""
+    encoded = set(encoded)
     bytes_per_row = 0
     for f in schema:
-        bytes_per_row += 4 if f.dtype == DType.STRING else 9  # value + mask
+        if f.dtype == DType.STRING:
+            bytes_per_row += 4  # i32 codes
+        elif f.name in encoded:
+            bytes_per_row += 2  # i16 dictionary codes (validity rides in them)
+        else:
+            bytes_per_row += 9  # value + mask
     bytes_per_row = max(bytes_per_row, 1)
     return int(min(max(target_bytes // bytes_per_row, 1 << 16), 1 << 24))
 
@@ -46,6 +63,18 @@ class BatchSource:
     def num_rows(self) -> Optional[int]:
         """Total rows if knowable from metadata, else None."""
         return None
+
+    @property
+    def encoded_column_names(self) -> frozenset:
+        """Columns this source delivers dictionary-ENCODED (int16 codes +
+        dictionary + validity bitmap as the Column payload). Drives the
+        source's encoded-aware batch SIZING (``batch_rows_for_schema``)
+        and is advertised for introspection/tests; note the scan engine
+        routes off the actual ``Column.encoding`` payload of each batch,
+        not this property — a custom source must attach ``ColumnChunk``
+        payloads (or call ``table.encode()`` per batch) for the encoded
+        plane to engage. Default: none."""
+        return frozenset()
 
     def batches(
         self,
@@ -97,6 +126,8 @@ def _restrict_arrow_schema(arrow_schema, names, what: str):
 def _arrow_field_dtype(pa_type) -> DType:
     import pyarrow as pa
 
+    if pa.types.is_dictionary(pa_type):
+        pa_type = pa_type.value_type
     if pa.types.is_integer(pa_type):
         return DType.INTEGRAL
     if pa.types.is_floating(pa_type):
@@ -104,6 +135,73 @@ def _arrow_field_dtype(pa_type) -> DType:
     if pa.types.is_boolean(pa_type):
         return DType.BOOLEAN
     return DType.STRING
+
+
+def _dictionary_encoded_columns(pf, names, schema) -> frozenset:
+    """The NUMERIC columns of one ParquetFile whose every column chunk
+    was written dictionary-encoded (metadata only; see the caller's
+    rationale comment). The writer's dictionary page itself is PLAIN, so
+    PLAIN next to a *_DICTIONARY encoding is normal — a genuinely
+    overflowed (high-cardinality fallback) column is caught later, at
+    re-encode time, by the int16 cardinality cap."""
+    meta = pf.metadata
+    # physical column order -> name, for the selected flat columns
+    phys_names = [
+        meta.schema.column(i).path for i in range(meta.num_columns)
+    ]
+    wanted = {
+        n for n in names
+        if schema[n].dtype in (DType.INTEGRAL, DType.FRACTIONAL)
+    }
+    encoded = set(wanted) if meta.num_row_groups else set()
+    for rg in range(meta.num_row_groups):
+        group = meta.row_group(rg)
+        for i, name in enumerate(phys_names):
+            if name not in encoded:
+                continue
+            encs = set(group.column(i).encodings)
+            if not encs & {"PLAIN_DICTIONARY", "RLE_DICTIONARY"}:
+                encoded.discard(name)
+    return frozenset(encoded)
+
+
+def _encode_arrow_batch(table, encode_names: set, batch_rows: int):
+    """Dictionary-encode the named columns of one Arrow table IN ARROW
+    (``pc.dictionary_encode``: hash-based C++, ~O(n)) so ``from_arrow``
+    carries codes + dictionary + validity through as the Column's
+    encoded payload instead of materializing full-width numpy values.
+    pyarrow's Parquet reader only returns DictionaryArrays for
+    byte-array columns, so numerics the file METADATA reports as
+    dictionary-encoded are re-encoded here — the decoded numpy form
+    still never exists. Columns whose dictionary exceeds the int16 cap
+    (the writer's overflow fallback) are dropped from ``encode_names``
+    (mutated) and stay plain for the rest of the stream."""
+    import pyarrow.compute as pc
+
+    from deequ_tpu.data.table import MAX_ENCODED_CARDINALITY
+
+    for name in sorted(encode_names & set(table.column_names)):
+        idx = table.column_names.index(name)
+        combined = table.column(idx).combine_chunks()
+        encoded = pc.dictionary_encode(combined)
+        # density rule: past 1 dictionary entry per 4 rows the encoded
+        # form (2B codes + 8B/distinct) stops beating the decoded
+        # 9B/row — near-unique columns (the writer's dictionary
+        # survived only because the file is small) stay plain. The
+        # denominator is the FULL batch size (never this batch's
+        # length: a short remainder/tail batch must not permanently
+        # demote a genuinely low-cardinality column for the rest of the
+        # stream), bounded by the source's total rows so a small file
+        # doesn't inherit a huge configured batch as its density budget
+        cap = min(
+            MAX_ENCODED_CARDINALITY,
+            max(max(batch_rows, len(combined)) // 4, 1),
+        )
+        if len(encoded.dictionary) > cap:
+            encode_names.discard(name)
+            continue
+        table = table.set_column(idx, name, encoded)
+    return table
 
 
 class ParquetBatchSource(BatchSource):
@@ -145,9 +243,18 @@ class ParquetBatchSource(BatchSource):
         self._schema = Schema(
             _restrict_arrow_schema(arrow_schema, names, "parquet schema")
         )
+        # dictionary-encoded column detection (metadata only): a column
+        # qualifies when EVERY row group of EVERY file wrote it purely
+        # dictionary-encoded — a 'PLAIN' encoding next to the dictionary
+        # one means the writer's dictionary page overflowed mid-chunk
+        # (the high-cardinality fallback) and the column must decode
+        self._encoded = _dictionary_encoded_columns(first, names, self._schema)
         n = first.metadata.num_rows
         for path in self.paths[1:]:
             pf = pq.ParquetFile(path)
+            self._encoded &= _dictionary_encoded_columns(
+                pf, names, self._schema
+            )
             # compare only the SELECTED fields, by name: batches() reads
             # columns by name per file, so extra/reordered unselected
             # columns in a later file are fine — a selected column that
@@ -181,6 +288,10 @@ class ParquetBatchSource(BatchSource):
     def num_rows(self) -> Optional[int]:
         return self._num_rows
 
+    @property
+    def encoded_column_names(self) -> frozenset:
+        return self._encoded
+
     def batches(
         self,
         columns: Optional[Sequence[str]] = None,
@@ -194,12 +305,26 @@ class ParquetBatchSource(BatchSource):
         names = list(columns) if columns is not None else self._schema.column_names
         names = [n for n in self._schema.column_names if n in set(names)]
         rows = batch_rows or self._batch_rows or batch_rows_for_schema(
-            Schema([self._schema[n] for n in names])
+            Schema([self._schema[n] for n in names]),
+            encoded=self._encoded & set(names),
         )
+        # columns the file metadata reports dictionary-encoded stay
+        # encoded end to end: re-encoded in Arrow per batch (see
+        # _encode_arrow_batch), then carried by from_arrow as the
+        # Column's ColumnChunk payload — codes + dictionary + validity
+        # bitmap, never decoded f64/i64 on host
+        enc_active = set(self._encoded & set(names))
+        # the density denominator: a full batch, or the whole (smaller)
+        # source — a 4k-row file read at a 16M-row default batch size
+        # must judge density against its 4k rows
+        cap_rows = min(rows, self._num_rows) if self._num_rows else rows
         for path in self.paths:
             pf = pq.ParquetFile(path, pre_buffer=self.pre_buffer)
             for record_batch in pf.iter_batches(batch_size=rows, columns=names):
-                yield from_arrow(pa.Table.from_batches([record_batch]))
+                tab = pa.Table.from_batches([record_batch])
+                if enc_active:
+                    tab = _encode_arrow_batch(tab, enc_active, cap_rows)
+                yield from_arrow(tab)
 
 
 def _bool_literals() -> frozenset:

@@ -19,6 +19,12 @@ from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple, Uni
 
 import numpy as np
 
+#: widest dictionary an encoded column chunk may carry: codes are int16
+#: (null = -1), so the dictionary must index in [0, 2^15). Past this the
+#: encoded form stops paying for itself anyway — codes approach the width
+#: of the values.
+MAX_ENCODED_CARDINALITY = (1 << 15) - 1
+
 
 class DType(enum.Enum):
     FRACTIONAL = "fractional"  # float64
@@ -61,10 +67,152 @@ class Schema:
         return f"Schema({inner})"
 
 
+@dataclass
+class ColumnChunk:
+    """The dictionary-encoded column-chunk payload — the Arrow/Parquet-
+    native form a ``Column`` can carry INSTEAD of decoded full-width
+    values (ROADMAP item 3: encoded device residency).
+
+    - ``codes``: int16 indices into ``dictionary``; -1 marks a null row
+      (normalized at construction — every invalid row's code is -1, so
+      device programs recover validity as ``codes >= 0`` without a
+      separate mask transfer);
+    - ``dictionary``: the decoded distinct values (float64 / int64),
+      at most :data:`MAX_ENCODED_CARDINALITY` entries;
+    - ``validity``: the packed null bitmap (``np.packbits``; 1 bit/row,
+      8x smaller than a bool mask), or None when every row is valid.
+
+    At 2 bytes/row vs the decoded planes' 8-9 (f32 pair + mask) /
+    4-5 (i32 + mask) bytes, the encoded form is the 2-8x smaller payload
+    both HBM residency and host->device staging carry; decode (a
+    dictionary gather) fuses into the scan program (``docs/ingest.md``).
+    """
+
+    codes: np.ndarray
+    dictionary: np.ndarray
+    validity: Optional[np.ndarray]
+    num_rows: int
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.dictionary)
+
+    @property
+    def nbytes(self) -> int:
+        return (
+            self.codes.nbytes
+            + self.dictionary.nbytes
+            + (self.validity.nbytes if self.validity is not None else 0)
+        )
+
+    def mask(self) -> np.ndarray:
+        """The validity bitmap unpacked to a bool row mask."""
+        if self.validity is None:
+            return np.ones(self.num_rows, dtype=np.bool_)
+        return np.unpackbits(self.validity, count=self.num_rows).astype(
+            np.bool_
+        )
+
+    def decode(self, np_dtype) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize (values, mask): a host dictionary gather with
+        invalid rows zeroed — exactly the full-width form the decoded
+        ingest path would have produced."""
+        mask = self.mask()
+        safe = np.where(mask, self.codes, 0).astype(np.int64)
+        if len(self.dictionary) == 0:
+            values = np.zeros(self.num_rows, dtype=np_dtype)
+        else:
+            values = self.dictionary[safe].astype(np_dtype)
+            values = np.where(mask, values, values.dtype.type(0))
+        return values, mask
+
+    def take(self, indices: np.ndarray) -> "ColumnChunk":
+        codes = self.codes[indices]
+        valid = codes >= 0
+        return ColumnChunk(
+            codes=codes,
+            dictionary=self.dictionary,
+            validity=None if bool(valid.all()) else np.packbits(valid),
+            num_rows=len(codes),
+        )
+
+    @staticmethod
+    def from_codes(
+        codes: np.ndarray,
+        dictionary: np.ndarray,
+        mask: Optional[np.ndarray] = None,
+    ) -> "ColumnChunk":
+        """Build from raw (possibly wider) codes + dictionary (the Arrow
+        DictionaryArray shape). Rows that are masked invalid OR carry a
+        negative/NaN-dictionary code are normalized to code -1."""
+        codes = np.asarray(codes)
+        valid = codes >= 0
+        if mask is not None:
+            valid = valid & np.asarray(mask, dtype=np.bool_)
+        dictionary = np.asarray(dictionary)
+        if np.issubdtype(dictionary.dtype, np.floating):
+            # engine convention (data/io.py): NaN == null. A NaN
+            # dictionary entry makes every row pointing at it null.
+            nan_slots = np.isnan(dictionary)
+            if nan_slots.any():
+                safe = np.where(valid, codes, 0)
+                valid = valid & ~nan_slots[safe]
+                dictionary = np.where(nan_slots, 0.0, dictionary)
+        out = np.where(valid, codes, -1).astype(np.int16)
+        return ColumnChunk(
+            codes=out,
+            dictionary=dictionary,
+            validity=None if bool(valid.all()) else np.packbits(valid),
+            num_rows=len(out),
+        )
+
+    @staticmethod
+    def from_values(
+        values: np.ndarray,
+        mask: np.ndarray,
+        max_cardinality: int = MAX_ENCODED_CARDINALITY,
+    ) -> Optional["ColumnChunk"]:
+        """Dictionary-encode decoded values, or None when the column is
+        not worth encoding (cardinality above ``max_cardinality`` — the
+        all-unique fallback). VALID NaNs (mask True) share one NaN
+        dictionary entry and stay valid: NaN==null folding is an ingest
+        convention (data/io.py), not an encoding one — an in-memory
+        column that deliberately carries NaN values round-trips."""
+        valid = np.asarray(mask, dtype=np.bool_)
+        vals = np.asarray(values)[valid]
+        is_float = np.issubdtype(vals.dtype, np.floating)
+        nan_rows = np.isnan(vals) if is_float else np.zeros(len(vals), bool)
+        finite = vals[~nan_rows]
+        dictionary = np.unique(finite)
+        has_nan = bool(nan_rows.any())
+        if len(dictionary) + has_nan > max_cardinality:
+            return None
+        codes16 = np.full(len(valid), -1, dtype=np.int16)
+        pos = np.searchsorted(dictionary, finite)
+        inner = np.empty(len(vals), dtype=np.int64)
+        inner[~nan_rows] = pos
+        if has_nan:
+            dictionary = np.concatenate([dictionary, [np.nan]])
+            inner[nan_rows] = len(dictionary) - 1
+        codes16[valid] = inner.astype(np.int16)
+        return ColumnChunk(
+            codes=codes16,
+            dictionary=dictionary,
+            validity=None if bool(valid.all()) else np.packbits(valid),
+            num_rows=len(valid),
+        )
+
+
 class Column:
     """One column: numeric/bool columns hold ``values`` + ``mask`` (True =
     valid); string columns hold int32 ``codes`` (-1 = null) + ``dictionary``
-    of distinct values."""
+    of distinct values.
+
+    Numeric columns may instead carry a dictionary-``encoded``
+    :class:`ColumnChunk` payload (Arrow/Parquet-native ingest,
+    ``Column.encode()``): ``values``/``mask`` then materialize LAZILY on
+    first host access, while the scan engine's encoded ingest path reads
+    the codes + dictionary directly and never decodes on host."""
 
     def __init__(
         self,
@@ -74,15 +222,24 @@ class Column:
         mask: Optional[np.ndarray] = None,
         codes: Optional[np.ndarray] = None,
         dictionary: Optional[np.ndarray] = None,
+        encoded: Optional[ColumnChunk] = None,
     ):
         self.name = name
         self.dtype = dtype
+        self.encoding: Optional[ColumnChunk] = None
         if dtype == DType.STRING:
             assert codes is not None and dictionary is not None
             self.codes = np.asarray(codes, dtype=np.int32)
             self.dictionary = np.asarray(dictionary, dtype=object)
-            self.values = None
-            self.mask = self.codes >= 0
+            self._values = None
+            self._mask = self.codes >= 0
+        elif encoded is not None:
+            assert values is None and mask is None
+            self.encoding = encoded
+            self._values = None
+            self._mask = None
+            self.codes = None
+            self.dictionary = None
         else:
             assert values is not None
             np_dtype = {
@@ -90,17 +247,63 @@ class Column:
                 DType.INTEGRAL: np.int64,
                 DType.BOOLEAN: np.bool_,
             }[dtype]
-            self.values = np.asarray(values, dtype=np_dtype)
-            self.mask = (
-                np.ones(len(self.values), dtype=np.bool_)
+            self._values = np.asarray(values, dtype=np_dtype)
+            self._mask = (
+                np.ones(len(self._values), dtype=np.bool_)
                 if mask is None
                 else np.asarray(mask, dtype=np.bool_)
             )
             self.codes = None
             self.dictionary = None
 
+    @property
+    def _np_dtype(self):
+        return {
+            DType.FRACTIONAL: np.float64,
+            DType.INTEGRAL: np.int64,
+            DType.BOOLEAN: np.bool_,
+        }[self.dtype]
+
+    @property
+    def values(self) -> Optional[np.ndarray]:
+        if self._values is None and self.encoding is not None:
+            self._values, self._mask = self.encoding.decode(self._np_dtype)
+        return self._values
+
+    @property
+    def mask(self) -> np.ndarray:
+        if self._mask is None and self.encoding is not None:
+            # mask alone never forces a value decode: the packed validity
+            # bitmap (or the -1 codes) carries it
+            self._mask = self.encoding.mask()
+        return self._mask
+
+    def encode(
+        self, max_cardinality: int = MAX_ENCODED_CARDINALITY
+    ) -> bool:
+        """Attach a dictionary encoding built from the decoded values
+        (in-memory tables opting into the encoded ingest path). Returns
+        True when the column now carries one; False for non-encodable
+        columns (string/boolean, or cardinality past the int16 cap — the
+        all-unique fallback stays on the decoded path)."""
+        if self.encoding is not None:
+            return True
+        if self.dtype not in (DType.FRACTIONAL, DType.INTEGRAL):
+            return False
+        enc = ColumnChunk.from_values(
+            self._values, self._mask, max_cardinality
+        )
+        if enc is None:
+            return False
+        self.encoding = enc
+        return True
+
     def __len__(self) -> int:
-        return len(self.codes) if self.dtype == DType.STRING else len(self.values)
+        if self.dtype == DType.STRING:
+            return len(self.codes)
+        if self._values is None and self.encoding is not None:
+            return self.encoding.num_rows
+        return len(self._values)
 
     @property
     def num_valid(self) -> int:
@@ -129,6 +332,13 @@ class Column:
             return Column(
                 self.name, self.dtype, codes=self.codes[indices],
                 dictionary=self.dictionary,
+            )
+        if self.encoding is not None:
+            # slicing an encoded column stays encoded (shared dictionary,
+            # sliced codes): batch sources cutting an encoded table into
+            # batches must not force a full-width decode per slice
+            return Column(
+                self.name, self.dtype, encoded=self.encoding.take(indices)
             )
         return Column(
             self.name, self.dtype, values=self.values[indices], mask=self.mask[indices]
@@ -182,13 +392,16 @@ class ColumnarTable:
 
     # -- device residency (the analogue of Spark df.persist()) --------------
 
-    def persist(self, mesh=None) -> "ColumnarTable":
+    def persist(self, mesh=None, encode: Optional[bool] = None) -> "ColumnarTable":
         """Pack + transfer all columns to device HBM once; subsequent scans
         stream from HBM instead of re-shipping host bytes. Multi-pass
-        workloads (profiler, repeated verification) become compute-bound."""
+        workloads (profiler, repeated verification) become compute-bound.
+        Dictionary-encoded columns (``ColumnarTable.encode()`` / Parquet
+        ingest) stay encoded in HBM — 2-8x smaller residency — unless
+        ``encode=False`` / DEEQU_TPU_ENCODED_INGEST=0."""
         from deequ_tpu.ops.scan_engine import persist_table
 
-        persist_table(self, mesh=mesh)
+        persist_table(self, mesh=mesh, encode=encode)
         return self
 
     def unpersist(self) -> "ColumnarTable":
@@ -203,6 +416,22 @@ class ColumnarTable:
     @property
     def is_persisted(self) -> bool:
         return self._device_cache is not None
+
+    def encode(
+        self,
+        columns: Optional[Sequence[str]] = None,
+        max_cardinality: int = MAX_ENCODED_CARDINALITY,
+    ) -> "ColumnarTable":
+        """Attach dictionary encodings to the (named, default: all)
+        numeric columns that qualify — the in-memory opt-in to the
+        encoded ingest path (Parquet sources arrive encoded already).
+        Non-encodable columns (string/boolean, cardinality past the
+        int16 cap) are silently left on the decoded path. Encode BEFORE
+        persist(): residency packs whatever form the columns carry."""
+        names = list(columns) if columns is not None else self.column_names
+        for name in names:
+            self.columns[name].encode(max_cardinality)
+        return self
 
     # -- constructors -------------------------------------------------------
 

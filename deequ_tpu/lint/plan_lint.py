@@ -39,6 +39,15 @@ Rules (ids are stable; severities per ``findings.LintFinding``):
   (docs/numerics.md): unsorted float scatter accumulation order is
   backend-dependent. Integer scatter-adds are exempt (integer addition
   is exactly associative — the selection kernel's histogram passes).
+- ``plan-encoded-decode`` (error) — an encoded-ingest plan
+  (``ingest_variant="encoded"``, docs/ingest.md) whose declared encoded
+  column is actually routed over a pre-decoded full-width plane
+  (wide/pair/hi-only/narrow) or missing from the code plane entirely —
+  the 2-8x transfer/residency win silently gone while ScanStats still
+  reports an encoded pass — or whose traced program contains a host
+  callback (an in-program decode round trip the fused-gather contract
+  forbids; re-asserted here per encoded program on top of
+  ``plan-host-callback`` so the encoded rule is self-contained).
 
 Results are memoized per (program identity, variant, mesh) so
 enforcement costs one trace per plan/kernel-variant, not one per scan —
@@ -247,6 +256,65 @@ def _check_fold_merge(plan_ir) -> List[LintFinding]:
     return findings
 
 
+#: the packer's pre-decoded full-width planes — an encoded column found
+#: on one of these defeats the encoded-ingest contract
+_DECODED_PLANES = ("wide", "pair", "hi_only", "narrow_i32")
+
+
+def _check_encoded_ingest(plan_ir, census: Optional[Counter]) -> List[LintFinding]:
+    """The ``plan-encoded-decode`` rule: declared encoded columns must
+    ride the code plane (and only it), and an encoded program must be
+    free of host callbacks."""
+    findings: List[LintFinding] = []
+    if getattr(plan_ir, "ingest_variant", "decoded") != "encoded":
+        return findings
+    layout = dict(plan_ir.layout or ())
+    enc_plane = set(layout.get("enc", ()))
+    for col in plan_ir.encoded_columns:
+        on_decoded = [
+            p for p in _DECODED_PLANES if col in layout.get(p, ())
+        ]
+        if on_decoded:
+            findings.append(
+                LintFinding(
+                    "plan-encoded-decode",
+                    "error",
+                    f"encoded-variant plan routes declared encoded column "
+                    f"{col!r} over pre-decoded full-width plane(s) "
+                    f"{on_decoded}: the decoded values would ship over "
+                    "the tunnel while the plan claims the 2-8x encoded "
+                    "form",
+                    location=f"column={col}",
+                )
+            )
+        elif col not in enc_plane:
+            findings.append(
+                LintFinding(
+                    "plan-encoded-decode",
+                    "error",
+                    f"declared encoded column {col!r} is on no packer "
+                    "plane at all: planner/packer drift",
+                    location=f"column={col}",
+                )
+            )
+    if census is not None:
+        callbacks = {
+            p: census[p] for p in _CALLBACK_PRIMITIVES if census.get(p)
+        }
+        if callbacks:
+            findings.append(
+                LintFinding(
+                    "plan-encoded-decode",
+                    "error",
+                    f"encoded-ingest program contains host-boundary "
+                    f"primitive(s) {callbacks}: decode must be a fused "
+                    "on-device dictionary gather, never a host round "
+                    "trip",
+                )
+            )
+    return findings
+
+
 def lint_plan(
     plan_ir,
     trace_fn: Optional[Callable] = None,
@@ -265,10 +333,14 @@ def lint_plan(
     # the probe would crash on an unknown tag before reporting cleanly
     if not findings:
         findings += _check_fold_merge(plan_ir)
+    if trace_fn is None:
+        # layout-only encoded checks still run without a traced program
+        findings += _check_encoded_ingest(plan_ir, None)
 
     if trace_fn is not None:
         closed = jax.make_jaxpr(trace_fn)(*avals)
         census = primitive_census(closed)
+        findings += _check_encoded_ingest(plan_ir, census)
         sorts = sum(census.get(p, 0) for p in _SORT_PRIMITIVES)
         if plan_ir.variant == "select" and sorts:
             findings.append(

@@ -302,6 +302,33 @@ class ScanStats:
         # identical plan add zero traces (the bench memoization assert)
         self.plan_lints = []
         self.plan_lint_traces = 0
+        # columnar ingest pipeline (round 8): host->device bytes moved
+        # through the double-buffered staging step of the packing loops,
+        # how many chunk transfers were staged, and how many of those
+        # were issued while an earlier chunk was still in flight — the
+        # structural observable behind ingest_overlap_frac (staging
+        # overlapped compute instead of serializing after it)
+        self.bytes_staged = 0
+        self.chunks_staged = 0
+        self.chunks_staged_overlapped = 0
+        # scans whose plan routed >= 1 column over the encoded (int16
+        # dictionary-code) plane, and fault-ladder demotions of an
+        # encoded attempt back onto the decoded path (the OOM response,
+        # mirroring the PR-6 selection->sort demotion)
+        self.encoded_scan_passes = 0
+        self.encoded_demotions = 0
+
+    @property
+    def ingest_overlap_frac(self) -> float:
+        """Fraction of staged chunk transfers issued while the previous
+        chunk was still STAGED (transferred but not yet dispatched) —
+        the defining property of the double-buffered ordering. A healthy
+        n-chunk scan shows (n-1)/n; a serial put-then-dispatch loop (the
+        regression this observable guards) shows 0.0, as does a
+        single-chunk scan."""
+        if not self.chunks_staged:
+            return 0.0
+        return self.chunks_staged_overlapped / self.chunks_staged
 
     def snapshot(self) -> dict:
         snap = dict(self.__dict__)
@@ -312,6 +339,7 @@ class ScanStats:
             tuple(r) for r in self.unverified_row_ranges
         ]
         snap["plan_lints"] = [dict(f) for f in self.plan_lints]
+        snap["ingest_overlap_frac"] = round(self.ingest_overlap_frac, 4)
         return snap
 
     def record_unverified(self, start: int, stop: int, reason: str) -> dict:
@@ -329,6 +357,18 @@ class ScanStats:
         one-fetch-per-scan contract counts) and its result bytes."""
         self.device_fetches += 1
         self.bytes_fetched += int(nbytes)
+
+    def record_staged(self, nbytes: int, overlapped: bool) -> None:
+        """Account one HOST->DEVICE chunk staging (the double-buffered
+        transfers of the packing loops). Staging is the opposite
+        direction from a fetch — it never counts against the one-fetch
+        contract; ``overlapped`` marks transfers issued while the
+        previous chunk was still staged-undispatched (see
+        ``ingest_overlap_frac``)."""
+        self.bytes_staged += int(nbytes)
+        self.chunks_staged += 1
+        if overlapped:
+            self.chunks_staged_overlapped += 1
 
     def record_degradation(self, kind: str, **detail) -> dict:
         """Append one degradation decision (kind: 'oom_bisect' |
@@ -441,6 +481,37 @@ def _compute_f64() -> bool:
     return os.environ.get("DEEQU_TPU_COMPUTE", "").lower() == "f64"
 
 
+def _enc_eligible(col: Column) -> bool:
+    """True when the column can ride the encoded (int16 dictionary-code)
+    plane: it carries a ColumnChunk encoding whose dictionary fits the
+    device decode path — pair-safe f64 values (fractional) or i32-safe
+    values (integral; the exact pair split runs on the gathered
+    dictionary entries). Predicate-boundary columns
+    (``_exact_compare``) route wide exactly as on the decoded path. The
+    O(cardinality) dictionary check is cached per Column like
+    ``_packs_as_i32``."""
+    enc = getattr(col, "encoding", None)
+    if enc is None or col.dtype not in (DType.FRACTIONAL, DType.INTEGRAL):
+        return False
+    if getattr(col, "_exact_compare", False):
+        return False
+    cached = getattr(col, "_enc_safe", None)
+    if cached is None:
+        from deequ_tpu.ops.df32 import pair_safe_np
+
+        d = enc.dictionary
+        if col.dtype == DType.INTEGRAL:
+            cached = bool(
+                len(d) == 0
+                or (-(2 ** 31) < int(d.min()) and int(d.max()) < 2 ** 31)
+            )
+        else:
+            # deequ-lint: ignore[host-fetch] -- d is the ColumnChunk's host numpy dictionary, never a device array
+            cached = pair_safe_np(np.asarray(d, dtype=np.float64))
+        col._enc_safe = cached
+    return cached
+
+
 _PAIR_COMPARE_WARNED: set = set()
 
 
@@ -484,7 +555,12 @@ class _ChunkPacker:
     - huge integers, |x| > f32_max fractionals, and DEEQU_TPU_COMPUTE=f64
       -> wide f64 plane (XLA software-f64 fallback);
     - DEEQU_TPU_TRANSFER_F32=1 -> hi plane only (lossy, opt-in);
-    - null-free columns ship no mask row (validity is just row_valid).
+    - null-free columns ship no mask row (validity is just row_valid);
+    - dictionary-ENCODED numeric columns (``encode_ingest=True``, round
+      8) -> int16 ``enc`` code plane, 2 bytes/row, null = -1 (no mask
+      row either — validity rides in the codes); the tiny dictionary
+      ships once as (hi, lo) / i32 LUT arguments and decode is a gather
+      fused into the scan program (docs/ingest.md).
     """
 
     def __init__(
@@ -492,6 +568,7 @@ class _ChunkPacker:
         cols: Dict[str, Column],
         chunk: int,
         layout: Optional[dict] = None,
+        encode_ingest: bool = False,
     ):
         numeric = [n for n, c in cols.items() if c.dtype != DType.STRING]
         self.string_names = [n for n, c in cols.items() if c.dtype == DType.STRING]
@@ -504,17 +581,31 @@ class _ChunkPacker:
             self.hi_only_names = list(layout["hi_only"])
             self.wide_names = list(layout["wide"])
             self.masked_names = list(layout["masked"])
+            self.enc_names = list(layout.get("enc", ()))
             for n in self.pair_names:
                 if getattr(cols.get(n), "_exact_compare", False):
                     _warn_pair_compare_once(n, cols.get(n))
         else:
             f32_mode = _transfer_f32()
             f64_mode = _compute_f64()
-            self.narrow_i32 = [n for n in numeric if _packs_as_i32(cols[n])]
+            # encoded routing first: enc columns leave the decoded-plane
+            # classification entirely (and their classification must not
+            # touch .values — that would force the decode the plane
+            # exists to avoid). Non-default numeric modes keep the
+            # decoded planes: wide-f64 has no (hi, lo) gather domain and
+            # hi-only is already half-width.
+            self.enc_names = (
+                [n for n in numeric if _enc_eligible(cols[n])]
+                if encode_ingest and not f64_mode and not f32_mode
+                else []
+            )
+            enc_set = set(self.enc_names)
+            decoded = [n for n in numeric if n not in enc_set]
+            self.narrow_i32 = [n for n in decoded if _packs_as_i32(cols[n])]
             self.pair_names = []
             self.hi_only_names = []
             if not f64_mode:
-                for n in numeric:
+                for n in decoded:
                     if cols[n].dtype != DType.FRACTIONAL:
                         continue
                     if f32_mode:
@@ -526,11 +617,12 @@ class _ChunkPacker:
                 | set(self.pair_names)
                 | set(self.hi_only_names)
             )
-            self.wide_names = [n for n in numeric if n not in routed]
+            self.wide_names = [n for n in decoded if n not in routed]
             # null-free columns don't ship a mask row at all — their
-            # validity is just row_valid (saves 1 byte/row/column)
+            # validity is just row_valid (saves 1 byte/row/column);
+            # encoded columns carry validity in their -1 codes
             self.masked_names = [
-                n for n in numeric if not bool(cols[n].mask.all())
+                n for n in decoded if not bool(cols[n].mask.all())
             ]
         self.numeric_names = numeric
         # the hi buffer carries pair columns first, then hi-only columns
@@ -538,14 +630,19 @@ class _ChunkPacker:
             n: i for i, n in enumerate(self.pair_names + self.hi_only_names)
         }
         self._mask_row = {n: i for i, n in enumerate(self.masked_names)}
+        self._enc_row = {n: i for i, n in enumerate(self.enc_names)}
         self.cols = cols
         self.chunk = chunk
-        # metadata-only view for trace closures: dtypes + string
+        # metadata-only view for trace closures: dtypes + string/encoded
         # dictionaries, NOT the column arrays — a traced program held in a
         # long-lived cache must not pin entire batches in host memory
+        # (encoded dictionaries are <= 2^15 entries by construction)
         self.col_dtype = {n: c.dtype for n, c in cols.items()}
         self.col_dict = {
             n: cols[n].dictionary for n in self.string_names
+        }
+        self.enc_dict = {
+            n: cols[n].encoding.dictionary for n in self.enc_names
         }
 
     def pack(self, start: int, stop: int):
@@ -570,6 +667,9 @@ class _ChunkPacker:
         narrow_i = buf(self.narrow_i32, np.int32, 0)
         masks = buf(self.masked_names, np.bool_, False)
         codes = buf(self.string_names, np.int32, -1)
+        # encoded plane: int16 dictionary codes; padding joins the null
+        # rows at -1, so device masks (code >= 0) need no row_valid AND
+        enc = buf(self.enc_names, np.int16, -1)
 
         for i, name in enumerate(self.wide_names):
             values[i, :n] = self.cols[name].values[start:stop]
@@ -588,29 +688,53 @@ class _ChunkPacker:
             masks[i, :n] = self.cols[name].mask[start:stop]
         for j, name in enumerate(self.string_names):
             codes[j, :n] = self.cols[name].codes[start:stop]
+        for i, name in enumerate(self.enc_names):
+            enc[i, :n] = self.cols[name].encoding.codes[start:stop]
         row_valid = np.zeros(chunk, dtype=np.bool_)
         row_valid[:n] = True
-        return values, hi, lo, narrow_i, masks, codes, row_valid
+        return values, hi, lo, narrow_i, masks, codes, row_valid, enc
 
     def unpack_vals(
         self, values, hi, lo, narrow_i, masks, codes, xp, row_valid=None,
-        col_luts=None,
+        col_luts=None, enc=None,
     ) -> Dict[str, Val]:
         """Slice the packed buffers back into per-column Vals (inside jit).
 
         Numeric Vals carry the two-float pair: ``data`` = f32 hi plane,
         ``lo`` = f32 lo plane (None for wide-f64 columns). Reductions go
         through ops/df32.py; the expression evaluator reconstructs f64
-        lazily (expr/eval.py:EvalContext.get)."""
+        lazily (expr/eval.py:EvalContext.get).
+
+        Encoded columns decode INSIDE the program: the int16 code plane
+        gathers the dictionary's precomputed (hi, lo) planes (fractional;
+        the split of a value is elementwise-deterministic, so the
+        gathered pair is bit-identical to splitting the decoded column)
+        or its i32 entries through the same on-device ``int32_pair`` the
+        narrow plane uses (integral). Validity is ``code >= 0``."""
         from deequ_tpu.ops.df32 import int32_pair
 
         vals: Dict[str, Val] = {}
+        for name in self.enc_names:
+            code = enc[self._enc_row[name]].astype(xp.int32)
+            mask = code >= 0
+            safe = xp.where(mask, code, 0)
+            luts = (col_luts or {}).get(name, {})
+            if self.col_dtype[name] == DType.INTEGRAL:
+                gathered = xp.take(luts["_enc_i32"], safe)
+                h, l = int32_pair(xp.where(mask, gathered, 0), xp)
+            else:
+                h = xp.where(mask, xp.take(luts["_enc_hi"], safe), 0.0)
+                l = xp.where(mask, xp.take(luts["_enc_lo"], safe), 0.0)
+            vals[name] = Val("num", h, mask, lo=l)
         pair_set = set(self.pair_names)
         hi_only_set = set(self.hi_only_names)
         narrow_set = set(self.narrow_i32)
+        enc_set = set(self.enc_names)
         wide_row = {n: i for i, n in enumerate(self.wide_names)}
         narrow_row = {n: i for i, n in enumerate(self.narrow_i32)}
         for name in self.numeric_names:
+            if name in enc_set:
+                continue  # decoded above, straight off the code plane
             if name in self._mask_row:
                 mask = masks[self._mask_row[name]]
             elif row_valid is not None:
@@ -660,6 +784,7 @@ class _ChunkPacker:
             "hi_only": tuple(self.hi_only_names),
             "wide": tuple(self.wide_names),
             "masked": tuple(self.masked_names),
+            "enc": tuple(self.enc_names),
         }
 
     def unpack_view(self) -> "_ChunkPacker":
@@ -673,12 +798,15 @@ class _ChunkPacker:
         view.wide_names = self.wide_names
         view.numeric_names = self.numeric_names
         view.masked_names = self.masked_names
+        view.enc_names = self.enc_names
         view._hi_row = self._hi_row
         view._mask_row = self._mask_row
+        view._enc_row = self._enc_row
         view.cols = None  # pack() is not available on a view
         view.chunk = self.chunk
         view.col_dtype = self.col_dtype
         view.col_dict = self.col_dict
+        view.enc_dict = self.enc_dict
         return view
 
 
@@ -726,7 +854,7 @@ class DeviceTableCache:
     def __init__(self, packer, chunk, device_chunks, mesh, nbytes, device_count):
         self.packer = packer
         self.chunk = chunk
-        self.device_chunks = device_chunks  # list of 7-tuples of device arrays (values, hi, lo, narrow_i, masks, codes, row_valid)
+        self.device_chunks = device_chunks  # list of 8-tuples of device arrays (values, hi, lo, narrow_i, masks, codes, row_valid, enc)
         self.mesh = mesh
         self.nbytes = nbytes
         self.device_count = device_count
@@ -751,7 +879,7 @@ class DeviceTableCache:
                 return None
             self._stacked = tuple(
                 jnp.stack([c[j] for c in self.device_chunks])
-                for j in range(7)
+                for j in range(8)
             )
         return self._stacked
 
@@ -807,12 +935,21 @@ def persist_table(
     mesh=None,
     chunk_rows: Optional[int] = None,
     max_bytes: int = DeviceTableCache.MAX_RESIDENT_BYTES,
+    encode: Optional[bool] = None,
 ) -> DeviceTableCache:
     """Pack ALL columns of the table and transfer them to device HBM once.
 
     Returns the cache and attaches it to ``table._device_cache`` so every
     subsequent ``run_scan`` over this table skips host packing + transfer.
+
+    Columns carrying a dictionary encoding stay ENCODED in HBM (int16
+    code plane + dictionary LUTs, 2-8x smaller than the decoded planes —
+    raising the fused-resident ceiling); scans decode via a fused gather.
+    ``encode`` overrides the DEEQU_TPU_ENCODED_INGEST default.
     """
+    from deequ_tpu.ops.scan_plan import encoded_ingest_enabled
+
+    encode = encoded_ingest_enabled(encode)
     if mesh is None:
         mesh = current_mesh()
     cols = {name: table[name] for name in table.column_names}
@@ -827,19 +964,8 @@ def persist_table(
     )
     chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
 
-    packer = _ChunkPacker(cols, chunk)
-    if mesh is not None:
-        from jax.sharding import NamedSharding
-
-        shardings = tuple(
-            [NamedSharding(mesh, P(None, ROW_AXIS))] * 6
-            + [NamedSharding(mesh, P(ROW_AXIS))]
-        )
-
-        def put(args):
-            return tuple(jax.device_put(a, s) for a, s in zip(args, shardings))
-    else:
-        put = jax.device_put
+    packer = _ChunkPacker(cols, chunk, encode_ingest=encode)
+    put = _make_put(mesh)
 
     n_chunks = max(1, (n_rows + chunk - 1) // chunk)
     device_chunks = []
@@ -861,18 +987,25 @@ def persist_table(
     return cache
 
 
+def _chunk_shardings(mesh):
+    """Per-buffer shardings for one packed chunk tuple (values, hi, lo,
+    narrow_i, masks, codes, row_valid, enc): column-planes shard rows
+    along axis 1, row_valid along axis 0."""
+    from jax.sharding import NamedSharding
+
+    plane = NamedSharding(mesh, P(None, ROW_AXIS))
+    return tuple(
+        [plane] * 6 + [NamedSharding(mesh, P(ROW_AXIS))] + [plane]
+    )
+
+
 def _make_put(mesh):
     """Async host->device transfer fn; in the mesh path buffers land
     host->each-device directly with the shardings matching in_specs (no
     redistribution hop)."""
     if mesh is None:
         return jax.device_put
-    from jax.sharding import NamedSharding
-
-    arg_shardings = tuple(
-        [NamedSharding(mesh, P(None, ROW_AXIS))] * 6
-        + [NamedSharding(mesh, P(ROW_AXIS))]
-    )
+    arg_shardings = _chunk_shardings(mesh)
 
     def put(args):
         return tuple(jax.device_put(a, s) for a, s in zip(args, arg_shardings))
@@ -898,14 +1031,14 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
     registers i32). ``lut_keys`` names the dictionary LUTs passed as an
     extra dict argument (replicated across the mesh)."""
 
-    def step(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
+    def step(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts):
         col_luts: Dict[str, Dict[str, Any]] = {}
         for key, arr in luts.items():
             col, kind = _split_lut_key(key)
             col_luts.setdefault(col, {})[kind] = arr
         vals = unpacker.unpack_vals(
             values, hi, lo, narrow_i, masks, codes, jnp, row_valid,
-            col_luts=col_luts,
+            col_luts=col_luts, enc=enc,
         )
         partials = tuple(op.update(vals, row_valid, jnp, local_n) for op in ops)
         if mesh is not None:
@@ -937,23 +1070,23 @@ def _build_step_fns(ops, unpacker, mesh, local_n, lut_keys: Tuple[str, ...] = ()
             in_specs=(
                 P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
                 P(None, ROW_AXIS), P(None, ROW_AXIS), P(None, ROW_AXIS),
-                P(ROW_AXIS),
+                P(ROW_AXIS), P(None, ROW_AXIS),
                 {key: P() for key in lut_keys},
             ),
             out_specs=P(),
             check_vma=False,
         )
 
-        def flat_outer(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
+        def flat_outer(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts):
             return _flatten(
-                inner(values, hi, lo, narrow_i, masks, codes, row_valid, luts)
+                inner(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts)
             )
 
         return jax.jit(flat_outer), inner, flat_outer
 
-    def flat_single(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
+    def flat_single(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts):
         return _flatten(
-            step(values, hi, lo, narrow_i, masks, codes, row_valid, luts)
+            step(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts)
         )
 
     return jax.jit(flat_single), step, flat_single
@@ -988,6 +1121,52 @@ def _collect_luts(ops, dictionaries: Dict[str, Any], mesh) -> Dict[str, Any]:
                 continue
             lut_arrays[key] = dictionary_lut_device(
                 dictionaries[col], kind, builder, mesh
+            )
+    return lut_arrays
+
+
+def _enc_hi_lut(d):
+    from deequ_tpu.ops.df32 import split_pair_np
+
+    # deequ-lint: ignore[host-fetch] -- d is a host numpy dictionary (lut_cache builder input), never a device array
+    return split_pair_np(np.asarray(d, dtype=np.float64))[0]
+
+
+def _enc_lo_lut(d):
+    from deequ_tpu.ops.df32 import split_pair_np
+
+    # deequ-lint: ignore[host-fetch] -- d is a host numpy dictionary (lut_cache builder input), never a device array
+    return split_pair_np(np.asarray(d, dtype=np.float64))[1]
+
+
+def _enc_i32_lut(d):
+    # deequ-lint: ignore[host-fetch] -- d is a host numpy dictionary (lut_cache builder input), never a device array
+    return np.asarray(d, dtype=np.int32)
+
+
+def _collect_enc_luts(packer, mesh) -> Dict[str, Any]:
+    """Device LUTs for the packer's ENCODED columns: the dictionary's
+    precomputed (hi, lo) pair planes (fractional — gathering the split of
+    a dictionary entry is bit-identical to splitting the decoded value)
+    or its i32 entries (integral). Memoized per dictionary identity like
+    the string LUTs (ops/lut_cache.py), pow2-padded, shipped once and
+    passed to the jitted step as arguments — re-runs ship no dictionary
+    bytes and programs stay cacheable across tables."""
+    from deequ_tpu.ops.lut_cache import dictionary_lut_device
+
+    lut_arrays: Dict[str, Any] = {}
+    for name in packer.enc_names:
+        d = packer.enc_dict[name]
+        if packer.col_dtype[name] == DType.INTEGRAL:
+            lut_arrays[name + "\x00_enc_i32"] = dictionary_lut_device(
+                d, "_enc_i32", _enc_i32_lut, mesh
+            )
+        else:
+            lut_arrays[name + "\x00_enc_hi"] = dictionary_lut_device(
+                d, "_enc_hi", _enc_hi_lut, mesh
+            )
+            lut_arrays[name + "\x00_enc_lo"] = dictionary_lut_device(
+                d, "_enc_lo", _enc_lo_lut, mesh
             )
     return lut_arrays
 
@@ -1038,6 +1217,7 @@ def _global_prog_key(prog_key, packer, mesh):
         tuple(packer.hi_only_names),
         tuple(packer.masked_names),
         tuple(packer.string_names),
+        tuple(packer.enc_names),
         # packer.col_dtype, not the caller's needed-column subset: a
         # persisted table's packer covers ALL table columns
         tuple((name, packer.col_dtype[name]) for name in packer.numeric_names),
@@ -1512,6 +1692,8 @@ def _maybe_plan_lint(
             memo_key = (
                 global_key,
                 plan_ir.variant,
+                plan_ir.ingest_variant,
+                plan_ir.encoded_columns,
                 plan_ir.fold_tags,
                 bool(fallback),
             )
@@ -1588,6 +1770,7 @@ def run_scan(
     shard_deadline: Optional[float] = None,
     select_kernel: Optional[bool] = None,
     plan_lint: Optional[str] = None,
+    encoded_ingest: Optional[bool] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1664,12 +1847,24 @@ def run_scan(
     trace per (plan, kernel-variant), observable via
     ``SCAN_STATS.plan_lint_traces``.
 
+    ``encoded_ingest`` (default: the DEEQU_TPU_ENCODED_INGEST env var,
+    default on) routes dictionary-encoded columns over the int16 code
+    plane with decode fused into the program (docs/ingest.md); ``False``
+    / DEEQU_TPU_ENCODED_INGEST=0 packs every column decoded — the A/B
+    escape hatch. A device OOM during an encoded attempt DEMOTES the
+    rest of the run onto the decoded path (recorded as an
+    ``encoded_demote`` degradation event) before any chunk bisection,
+    exactly like the selection->sort re-plan.
+
     ``defer=True`` scans dispatch under the same typed boundaries, but
     errors surfacing at ``result()`` are past bisection/fallback — the
     caller holds the only retry point then.
     """
     from deequ_tpu.lint.plan_lint import plan_lint_mode
-    from deequ_tpu.ops.scan_plan import select_kernel_enabled
+    from deequ_tpu.ops.scan_plan import (
+        encoded_ingest_enabled,
+        select_kernel_enabled,
+    )
 
     if on_device_error not in ("fail", "fallback"):
         raise ValueError(
@@ -1679,6 +1874,10 @@ def run_scan(
     # resolve (and validate) the selection-kernel switch ONCE per run so
     # every bisection/reshard attempt plans against the same setting
     select_kernel = select_kernel_enabled(select_kernel)
+    # same for the encoded-ingest switch; unlike select_kernel it is
+    # also the ladder's DEMOTION state — an OOM mid-encoded-scan flips
+    # it off for every subsequent attempt of this run
+    encoded_ingest = encoded_ingest_enabled(encoded_ingest)
     # same for the plan-lint mode: every attempt of the fault ladder
     # lints (or doesn't) under one resolved setting
     plan_lint = plan_lint_mode(plan_lint)
@@ -1713,7 +1912,7 @@ def run_scan(
             table, ops, chunk_rows, mesh,
             scan_id=scan_id, device_deadline=stream_deadline,
             window=window, select_kernel=select_kernel,
-            plan_lint=plan_lint,
+            plan_lint=plan_lint, encoded=encoded_ingest,
         )
 
     chunk_override = chunk_rows
@@ -1835,11 +2034,13 @@ def run_scan(
                         table, ops, chunk_override, None, defer,
                         None, scan_ctx, report, window,
                         select_kernel=select_kernel, plan_lint=plan_lint,
+                        encoded=encoded_ingest,
                     )
             result = _run_scan_once(
                 table, ops, chunk_override, mesh, defer,
                 attempt_deadline, scan_ctx, report, window,
                 select_kernel=select_kernel, plan_lint=plan_lint,
+                encoded=encoded_ingest,
             )
             DEVICE_HEALTH.record_success()
             if n_dev > 1:
@@ -1851,6 +2052,21 @@ def run_scan(
                 DEVICE_HEALTH.record_fault(e)
             used = report.get("chunk") or chunk_override or DEFAULT_CHUNK_ROWS
             freed = _evict_device_cache(table)
+            # encoded -> decoded demotion FIRST, like the PR-6
+            # selection -> sort re-plan: the encoded attempt's decode
+            # gathers/dictionary LUTs are the allocations the fault
+            # implicates that the decoded program simply doesn't have —
+            # retry on the known-good decoded path at the same chunk
+            # size; a recurring OOM there bisects as before
+            if not fallback and encoded_ingest and report.get("encoded"):
+                encoded_ingest = False
+                SCAN_STATS.encoded_demotions += 1
+                SCAN_STATS.record_degradation(
+                    "encoded_demote", scan_id=scan_id, chunk=int(used),
+                    evicted_bytes=freed, error=str(e),
+                )
+                attempt += 1
+                continue
             halved = max(floor, used // 2)
             halved = max(n_dev, (halved // n_dev) * n_dev)
             if halved < used and not fallback:
@@ -1943,11 +2159,13 @@ def _run_scan_once(
     window: int = DEFAULT_SCAN_WINDOW,
     select_kernel: bool = True,
     plan_lint: str = "off",
+    encoded: bool = True,
 ) -> List[Any]:
     """One attempt of the fused in-memory scan (the pre-fault-tolerance
     run_scan body, instrumented at the three device boundaries).
-    ``report`` returns the chunk size actually used so the bisection
-    driver can halve it."""
+    ``report`` returns the chunk size actually used (and whether the
+    attempt ran the encoded ingest variant) so the bisection/demotion
+    driver can react."""
     from deequ_tpu.ops.scan_plan import plan_scan_ops
     n_rows = table.num_rows
     needed = sorted({c for op in ops for c in op.columns})
@@ -1958,6 +2176,11 @@ def _run_scan_once(
     # device-resident fast path: table was persist()ed with a compatible
     # mesh — stream chunks straight from HBM, no packing, no transfer
     cache = getattr(table, "_device_cache", None)
+    if cache is not None and cache.packer.enc_names and not encoded:
+        # encoded residency cannot serve a decoded-path attempt (the
+        # A/B switch, or a fault-ladder demotion whose eviction raced a
+        # concurrent re-persist): bypass it, scan from host decoded
+        cache = None
     if cache is not None and not cache.mesh_matches(mesh):
         # a mesh change (degraded-mesh reshard, explicit use_mesh) strands
         # the per-device shards on devices that may no longer be in the
@@ -1986,7 +2209,7 @@ def _run_scan_once(
         chunk = chunk_rows or min(_auto_chunk_rows(cols), max(n_rows, 1))
         # static shapes: round the chunk up so it splits evenly across devices
         chunk = max(n_dev, ((chunk + n_dev - 1) // n_dev) * n_dev)
-        packer = _ChunkPacker(cols, chunk)
+        packer = _ChunkPacker(cols, chunk, encode_ingest=encoded)
     report["chunk"] = chunk
     local_n = chunk // n_dev if mesh is not None else chunk
 
@@ -1998,12 +2221,17 @@ def _run_scan_once(
         ops, packer, resident=cache is not None, select_kernel=select_kernel
     )
     ops = plan_ir.ops
+    report["encoded"] = plan_ir.ingest_variant == "encoded"
+    if report["encoded"]:
+        SCAN_STATS.encoded_scan_passes += 1
 
     # dictionary LUTs ship once (memoized device arrays) and enter the
-    # jitted step as arguments
+    # jitted step as arguments; encoded columns add their dictionary's
+    # decode planes the same way
     lut_arrays = _collect_luts(
         ops, {n: packer.col_dict.get(n) for n in packer.string_names}, mesh
     )
+    lut_arrays.update(_collect_enc_luts(packer, mesh))
     lut_sig = _lut_sig(lut_arrays)
     baked = any(op.dictionary_baked for op in ops)
 
@@ -2205,33 +2433,18 @@ def _run_scan_once(
                             deadline=device_deadline,
                         )
     else:
-        for ci in range(n_chunks):
-            start = ci * chunk
-            stop = min(start + chunk, n_rows)
-            args = packer.pack(start, stop)
-            SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
-            if ci == 0:
-                # static plan lint on the first chunk's shapes, before
-                # its transfer/dispatch (memoized per program identity)
-                _maybe_plan_lint(
-                    plan_ir, raw_flat, args, lut_arrays,
-                    prog_key, packer, mesh, plan_lint,
-                    fallback=bool(scan_ctx.get("fallback")),
-                )
-            if folder.shapes is None:
-                folder.shapes = device_call(
-                    lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
-                    "trace", what="fused-scan trace",
-                )
-                if global_key is not None:
-                    _GLOBAL_PROGRAMS.put(
-                        global_key, (step_fn, folder.shapes, raw_flat)
-                    )
+        # double-buffered host->device staging (round 8, the Eiger
+        # discipline): chunk k+1's async device_put is ISSUED before
+        # chunk k's dispatch, so the transfer rides the tunnel while the
+        # device computes — staged-but-undispatched chunks live in
+        # `pending_stage` (depth 1: one buffer in transfer, one in
+        # compute), and ScanStats.record_staged observes both the bytes
+        # and whether each transfer had in-flight work to hide behind
+        pending_stage: List[Tuple] = []
+
+        def dispatch_staged(entry) -> None:
+            device_args, ci = entry
             t_d = _time.time()
-            device_args = device_call(
-                lambda: put(args), "transfer",
-                what=f"chunk {ci} transfer", deadline=device_deadline,
-            )
             flat = device_call(
                 lambda: step_fn(*device_args, lut_arrays),
                 "execute", what=f"chunk {ci} dispatch",
@@ -2261,6 +2474,49 @@ def _run_scan_once(
                         "execute", what=f"chunk drain (window at {ci})",
                         deadline=device_deadline,
                     )
+
+        for ci in range(n_chunks):
+            start = ci * chunk
+            stop = min(start + chunk, n_rows)
+            args = packer.pack(start, stop)
+            SCAN_STATS.bytes_packed += sum(a.nbytes for a in args)
+            if ci == 0:
+                # static plan lint on the first chunk's shapes, before
+                # its transfer/dispatch (memoized per program identity)
+                _maybe_plan_lint(
+                    plan_ir, raw_flat, args, lut_arrays,
+                    prog_key, packer, mesh, plan_lint,
+                    fallback=bool(scan_ctx.get("fallback")),
+                )
+            if folder.shapes is None:
+                folder.shapes = device_call(
+                    lambda: jax.eval_shape(shape_fn, *args, lut_arrays),
+                    "trace", what="fused-scan trace",
+                )
+                if global_key is not None:
+                    _GLOBAL_PROGRAMS.put(
+                        global_key, (step_fn, folder.shapes, raw_flat)
+                    )
+            # overlapped iff the PREVIOUS chunk is still staged
+            # (transferred but undispatched) — true only under the
+            # double-buffered ordering; a serial put-then-dispatch loop
+            # always sees an empty stage here and reports 0, so the
+            # observable genuinely detects a dead double buffer
+            overlapped = bool(pending_stage)
+            t_d = _time.time()
+            device_args = device_call(
+                lambda: put(args), "transfer",
+                what=f"chunk {ci} transfer", deadline=device_deadline,
+            )
+            SCAN_STATS.dispatch_seconds += _time.time() - t_d
+            SCAN_STATS.record_staged(
+                sum(a.nbytes for a in args), overlapped
+            )
+            pending_stage.append((device_args, ci))
+            if len(pending_stage) > 1:
+                dispatch_staged(pending_stage.pop(0))
+        while pending_stage:
+            dispatch_staged(pending_stage.pop(0))
     if use_fold and acc is not None:
         folder.fold_plan = plan
         folder.fold_filled = folded
@@ -2479,14 +2735,14 @@ def run_scan_group(
         SCAN_STATS.programs_built += 1
         view = packer.unpack_view()
 
-        def single_tree(values, hi, lo, narrow_i, masks, codes, row_valid, luts):
+        def single_tree(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts):
             col_luts: Dict[str, Dict[str, Any]] = {}
             for key, arr in luts.items():
                 lcol, lkind = _split_lut_key(key)
                 col_luts.setdefault(lcol, {})[lkind] = arr
             vals = view.unpack_vals(
                 values, hi, lo, narrow_i, masks, codes, jnp, row_valid,
-                col_luts=col_luts,
+                col_luts=col_luts, enc=enc,
             )
             return tuple(
                 jax.tree.map(
@@ -2602,26 +2858,38 @@ def _prefetch(iterator, depth: int = 2):
 def _layout_upgrades(layout: dict, cols: Dict[str, Column]) -> Optional[dict]:
     """Check one batch against the stream's pinned packer layout; returns
     an upgraded layout if this batch cannot use it (an int column outgrew
-    i32, a fractional column outgrew the f32 pair range, or a previously
-    null-free column produced nulls), else None. Upgrades are monotone
-    (narrow -> wide, pair -> wide, unmasked -> masked), so a stream
-    retraces at most a handful of times."""
+    i32, a fractional column outgrew the f32 pair range, a previously
+    null-free column produced nulls, or an ENCODED column arrived
+    without a usable dictionary encoding), else None. Upgrades are
+    monotone (enc -> wide, narrow -> wide, pair -> wide, unmasked ->
+    masked), so a stream retraces at most a handful of times."""
     promote = [
         n for n in layout["narrow_i32"] if n in cols and not _packs_as_i32(cols[n])
     ]
     promote += [
         n for n in layout["pair"] if n in cols and not _packs_as_pair(cols[n])
     ]
+    # an encoded column whose later batch lost the encoding (the source's
+    # high-cardinality fallback kicked in mid-stream, or the dictionary
+    # outgrew the decode domain) leaves the code plane for wide f64 —
+    # exact for any value, and enc validity folds into the mask row
+    enc_demote = [
+        n
+        for n in layout.get("enc", ())
+        if n in cols and not _enc_eligible(cols[n])
+    ]
     promote_set = set(promote)
+    enc_demote_set = set(enc_demote)
     masked = set(layout["masked"])
     need_mask = [
         n
         for n, c in cols.items()
         if c.dtype != DType.STRING
         and n not in masked
+        and n not in set(layout.get("enc", ())) - enc_demote_set
         and not bool(c.mask.all())
     ]
-    if not promote and not need_mask:
+    if not promote and not need_mask and not enc_demote:
         return None
     return {
         "narrow_i32": tuple(
@@ -2629,8 +2897,11 @@ def _layout_upgrades(layout: dict, cols: Dict[str, Column]) -> Optional[dict]:
         ),
         "pair": tuple(n for n in layout["pair"] if n not in promote_set),
         "hi_only": layout["hi_only"],
-        "wide": tuple(list(layout["wide"]) + promote),
+        "wide": tuple(list(layout["wide"]) + promote + enc_demote),
         "masked": tuple(list(layout["masked"]) + need_mask),
+        "enc": tuple(
+            n for n in layout.get("enc", ()) if n not in enc_demote_set
+        ),
     }
 
 
@@ -2659,13 +2930,18 @@ def _run_scan_stream(
     window: int = DEFAULT_SCAN_WINDOW,
     select_kernel: bool = True,
     plan_lint: str = "off",
+    encoded: bool = True,
 ) -> List[Any]:
     """One fused pass over a StreamingTable: batches stream off storage on
-    a reader thread, pack into fixed-size chunks, and dispatch with a small
-    in-flight window — host read, H2D transfer, and device compute overlap,
-    and host memory stays bounded by a few batches regardless of dataset
-    size (the TB-scale design intent of the reference,
-    profiles/ColumnProfiler.scala:57-68).
+    a reader thread, pack into fixed-size chunks, and stage through a
+    DOUBLE BUFFER — chunk k+1's async host->device transfer is issued
+    before chunk k's dispatch, so host read, H2D transfer, and device
+    compute overlap (``ScanStats.ingest_overlap_frac`` / ``bytes_staged``
+    observe it) — and host memory stays bounded by a few batches
+    regardless of dataset size (the TB-scale design intent of the
+    reference, profiles/ColumnProfiler.scala:57-68). Batches carrying
+    dictionary-encoded columns ship int16 codes instead of decoded
+    values (``encoded``; docs/ingest.md).
 
     The packer layout is pinned on the first batch so the traced program is
     reused across every numeric batch of the stream (string columns bake
@@ -2717,6 +2993,7 @@ def _run_scan_stream(
     folder = _PartialFolder(ops)
     in_flight = []
     chunk_counter = [0]
+    encoded_counted = [False]
     # on-device partial fold across the WHOLE stream: instead of a fetch
     # per chunk, the accumulator drains only when its fixed gather
     # capacity fills (STREAM_FOLD_CAPACITY chunks) and once at the end —
@@ -2725,6 +3002,69 @@ def _run_scan_stream(
         device_foldable(op) for op in ops
     )
     fold_state: Dict[str, Any] = {"plan": None, "acc": None, "filled": 0}
+    # double-buffered staging across the whole stream (batch boundaries
+    # included): each entry is a transferred-but-undispatched chunk WITH
+    # the program it was packed for — a mid-stream layout upgrade must
+    # dispatch the staged chunk under its own (old-layout) program
+    pending_stage: List[Tuple] = []
+
+    def dispatch_staged(entry) -> None:
+        fn, device_args, luts, idx = entry
+        t_d = _time.time()
+        flat = device_call(
+            lambda: fn(*device_args, luts),
+            "execute",
+            what=f"stream chunk {idx} dispatch",
+            deadline=device_deadline,
+            hook_ctx={
+                "scan_id": scan_id, "attempt": 0, "fallback": False,
+                "chunk_index": idx,
+                "device_ids": mesh_device_ids(mesh),
+            },
+        )
+        SCAN_STATS.dispatch_seconds += _time.time() - t_d
+        _record_kernel_passes(plan_ir, 1)
+        if use_fold:
+            if fold_state["plan"] is None:
+                fold_state["plan"] = _fold_plan_for(
+                    ops, folder.shapes, STREAM_FOLD_CAPACITY
+                )
+            if fold_state["acc"] is None:
+                # first chunk, or a fresh accumulator after a
+                # capacity drain
+                fold_state["acc"] = fold_state["plan"].fresh_init()
+            plan, acc = fold_state["plan"], fold_state["acc"]
+            fold_state["acc"] = device_call(
+                lambda: plan.merge(acc, flat),
+                "execute", what="stream chunk fold",
+                deadline=device_deadline,
+            )
+            fold_state["filled"] += 1
+            in_flight.append(flat)
+            if len(in_flight) >= window:
+                oldest = in_flight.pop(0)
+                device_call(
+                    lambda: _block_throttle(oldest),
+                    "execute", what="stream chunk throttle",
+                    deadline=device_deadline,
+                )
+            # only gather leaves grow with the chunk count: a
+            # gather-free accumulator never overflows, so it folds
+            # the WHOLE stream into one final fetch (and never pays
+            # the restart's f64 sum regrouping)
+            if (
+                fold_state["filled"] >= STREAM_FOLD_CAPACITY
+                and plan.gather_size > 0
+            ):
+                drain_fold()
+        else:
+            in_flight.append(flat)
+            if len(in_flight) >= window:
+                device_call(
+                    lambda: folder.drain(in_flight.pop(0)),
+                    "execute", what="stream chunk drain",
+                    deadline=device_deadline,
+                )
 
     def drain_fold() -> None:
         if fold_state["acc"] is None:
@@ -2767,17 +3107,21 @@ def _run_scan_stream(
             if name in cols:
                 cols[name]._exact_compare = True
         if layout is None:
-            layout = _ChunkPacker(cols, chunk).layout()
+            layout = _ChunkPacker(cols, chunk, encode_ingest=encoded).layout()
         else:
             upgraded = _layout_upgrades(layout, cols)
             if upgraded is not None:
                 layout = upgraded
                 current_prog = None
         packer = _ChunkPacker(cols, chunk, layout=layout)
+        if packer.enc_names and not encoded_counted[0]:
+            encoded_counted[0] = True
+            SCAN_STATS.encoded_scan_passes += 1
 
         lut_arrays = _collect_luts(
             ops, {c: packer.col_dict.get(c) for c in packer.string_names}, mesh
         )
+        lut_arrays.update(_collect_enc_luts(packer, mesh))
         lut_sig = _lut_sig(lut_arrays)
         prog_key = _ops_prog_key(ops, chunk, lut_sig)
         sig = (tuple(sorted(layout.items())), lut_sig)
@@ -2811,9 +3155,15 @@ def _run_scan_stream(
             if sig not in linted_sigs:
                 # static plan lint before this program's first
                 # transfer/dispatch — runs again after a mid-stream
-                # layout upgrade (new sig = new traced program)
+                # layout upgrade (new sig = new traced program). The
+                # lint checks THIS signature's packer-derived plan, so
+                # encoded-ingest contracts hold per program
                 _maybe_plan_lint(
-                    plan_ir, raw_flat, args, lut_arrays,
+                    plan_scan_ops(
+                        ops, packer, resident=False,
+                        select_kernel=select_kernel,
+                    ),
+                    raw_flat, args, lut_arrays,
                     prog_key, packer, mesh, plan_lint,
                 )
                 linted_sigs.add(sig)
@@ -2830,67 +3180,29 @@ def _run_scan_stream(
                         )
             if folder.shapes is None:
                 folder.shapes = shapes
+            # double-buffered staging: issue THIS chunk's async transfer
+            # before the PREVIOUS chunk's dispatch, so the H2D bytes
+            # move while the device computes (Eiger's staging
+            # discipline); overlapped iff the previous chunk is still
+            # staged-undispatched — a serial loop reports 0 (see the
+            # in-memory loop's rationale comment)
+            overlapped = bool(pending_stage)
             t_d = _time.time()
             device_args = device_call(
                 lambda: put(args), "transfer",
                 what=f"stream chunk {chunk_counter[0]} transfer",
                 deadline=device_deadline,
             )
-            flat = device_call(
-                lambda: step_fn(*device_args, lut_arrays),
-                "execute",
-                what=f"stream chunk {chunk_counter[0]} dispatch",
-                deadline=device_deadline,
-                hook_ctx={
-                    "scan_id": scan_id, "attempt": 0, "fallback": False,
-                    "chunk_index": chunk_counter[0],
-                    "device_ids": mesh_device_ids(mesh),
-                },
+            SCAN_STATS.dispatch_seconds += _time.time() - t_d
+            SCAN_STATS.record_staged(
+                sum(a.nbytes for a in args), overlapped
+            )
+            pending_stage.append(
+                (step_fn, device_args, lut_arrays, chunk_counter[0])
             )
             chunk_counter[0] += 1
-            SCAN_STATS.dispatch_seconds += _time.time() - t_d
-            _record_kernel_passes(plan_ir, 1)
-            if use_fold:
-                if fold_state["plan"] is None:
-                    fold_state["plan"] = _fold_plan_for(
-                        ops, folder.shapes, STREAM_FOLD_CAPACITY
-                    )
-                if fold_state["acc"] is None:
-                    # first chunk, or a fresh accumulator after a
-                    # capacity drain
-                    fold_state["acc"] = fold_state["plan"].fresh_init()
-                plan, acc = fold_state["plan"], fold_state["acc"]
-                fold_state["acc"] = device_call(
-                    lambda: plan.merge(acc, flat),
-                    "execute", what="stream chunk fold",
-                    deadline=device_deadline,
-                )
-                fold_state["filled"] += 1
-                in_flight.append(flat)
-                if len(in_flight) >= window:
-                    oldest = in_flight.pop(0)
-                    device_call(
-                        lambda: _block_throttle(oldest),
-                        "execute", what="stream chunk throttle",
-                        deadline=device_deadline,
-                    )
-                # only gather leaves grow with the chunk count: a
-                # gather-free accumulator never overflows, so it folds
-                # the WHOLE stream into one final fetch (and never pays
-                # the restart's f64 sum regrouping)
-                if (
-                    fold_state["filled"] >= STREAM_FOLD_CAPACITY
-                    and plan.gather_size > 0
-                ):
-                    drain_fold()
-            else:
-                in_flight.append(flat)
-                if len(in_flight) >= window:
-                    device_call(
-                        lambda: folder.drain(in_flight.pop(0)),
-                        "execute", what="stream chunk drain",
-                        deadline=device_deadline,
-                    )
+            if len(pending_stage) > 1:
+                dispatch_staged(pending_stage.pop(0))
             if stop >= n:
                 break
 
@@ -2903,6 +3215,11 @@ def _run_scan_stream(
     if not got_any:
         # identity partials from one all-padding chunk
         process_cols(_empty_batch_cols(schema, needed), 0)
+
+    # flush the staged tail: the last chunk's transfer has no successor
+    # to overlap with — dispatch it now
+    while pending_stage:
+        dispatch_staged(pending_stage.pop(0))
 
     if use_fold:
         drain_fold()  # the (usually only) fetch of the whole stream scan

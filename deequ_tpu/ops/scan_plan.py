@@ -48,6 +48,28 @@ import jax
 KNOWN_FOLD_TAGS = frozenset(("sum", "min", "max", "gather"))
 
 
+def encoded_ingest_enabled(param: Optional[bool] = None) -> bool:
+    """Resolve the encoded-ingest switch: explicit argument wins, then
+    the DEEQU_TPU_ENCODED_INGEST env var ('0' disables — the A/B and
+    regression-triage escape hatch, mirroring DEEQU_TPU_SELECT_KERNEL),
+    then on. When on, columns carrying a dictionary encoding ride the
+    int16 ``enc`` plane (codes only over the tunnel; decode is a
+    dictionary gather fused into the scan program); off routes every
+    column through the decoded planes exactly as before round 8."""
+    if param is not None:
+        if not isinstance(param, (bool, int)) or param not in (0, 1):
+            raise ValueError(
+                f"encoded_ingest must be True/False, got {param!r}"
+            )
+        return bool(param)
+    raw = os.environ.get("DEEQU_TPU_ENCODED_INGEST", "").strip()
+    if raw not in ("", "0", "1"):
+        raise ValueError(
+            f"DEEQU_TPU_ENCODED_INGEST must be '0' or '1', got {raw!r}"
+        )
+    return raw != "0"
+
+
 def select_kernel_enabled(param: Optional[bool] = None) -> bool:
     """Resolve the selection-kernel switch: explicit argument wins, then
     the DEEQU_TPU_SELECT_KERNEL env var ('0' disables — the A/B and
@@ -98,7 +120,15 @@ class ScanPlan:
     - ``fetch_contract`` — ``"one-fetch"`` when every op is
       device-foldable (the whole scan pays one device->host fetch) else
       ``"per-chunk"``; traced programs must contain no host callbacks
-      either way."""
+      either way;
+    - ``ingest_variant`` — ``"encoded"`` when at least one column rides
+      the packer's int16 ``enc`` plane (dictionary codes on device,
+      decode gathered inside the fused program), else ``"decoded"``.
+      ``encoded_columns`` names them and ``layout`` snapshots the full
+      packer plane routing — the ``plan-encoded-decode`` lint rule
+      (deequ_tpu/lint/plan_lint.py) rejects an encoded-variant plan
+      whose declared encoded column actually arrives pre-decoded on a
+      full-width plane, or whose program smuggles a host callback."""
 
     ops: Tuple
     resident: bool
@@ -107,6 +137,11 @@ class ScanPlan:
     variant: str = "none"
     fold_tags: Tuple[Tuple[str, ...], ...] = ()
     fetch_contract: str = "per-chunk"
+    ingest_variant: str = "decoded"
+    encoded_columns: Tuple[str, ...] = ()
+    #: hashable snapshot of the packer layout (tuple of (plane, names)),
+    #: None when the attempt has no packer yet (streams before batch 1)
+    layout: Optional[Tuple] = None
 
 
 def _selectable(op, packer) -> bool:
@@ -116,8 +151,14 @@ def _selectable(op, packer) -> bool:
     whose 64-bit keys the u32 radix passes cannot cover."""
     if packer is None:
         return False
-    keyed = set(packer.pair_names) | set(packer.narrow_i32) | set(
-        packer.hi_only_names
+    # encoded columns qualify: the dictionary gather reconstructs the
+    # SAME (hi, lo) plane Val the pair/i32 routes produce, so the
+    # selection kernel's u32 key space is identical
+    keyed = (
+        set(packer.pair_names)
+        | set(packer.narrow_i32)
+        | set(packer.hi_only_names)
+        | set(getattr(packer, "enc_names", ()))
     )
     return all(c in keyed for c in op.select_columns)
 
@@ -158,6 +199,16 @@ def plan_scan_ops(
         variant = "mixed"
     else:
         variant = "none"
+    enc_cols = (
+        tuple(getattr(packer, "enc_names", ()) or ())
+        if packer is not None
+        else ()
+    )
+    layout = (
+        tuple(sorted((k, tuple(v)) for k, v in packer.layout().items()))
+        if packer is not None
+        else None
+    )
     return ScanPlan(
         ops=tuple(resolved),
         resident=resident,
@@ -173,4 +224,7 @@ def plan_scan_ops(
             if all(op.compact is None for op in resolved)
             else "per-chunk"
         ),
+        ingest_variant="encoded" if enc_cols else "decoded",
+        encoded_columns=enc_cols,
+        layout=layout,
     )
