@@ -363,6 +363,68 @@ def measure_plan_lint_overhead(table, analyzers):
     }
 
 
+def measure_governance_overhead(n_rows: int):
+    """Run-governance cost probe (resilience/governance.py): the
+    config-1 shape — several small/medium suites back to back — timed
+    ungoverned vs under an armed RunBudget (wall deadline + attempt
+    cap, both far from binding). The healthy path must charge NOTHING
+    (hard-asserted via ``ScanStats.budget_charges``) and cost <1% of
+    wall: budget resolution is two dict lookups per run, and the
+    remaining-wall watchdog cap is one subtraction per scan attempt.
+    min-of-reps on both sides sees through scheduler noise."""
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.resilience.governance import RunPolicy, run_budget_scope
+
+    table = build_table(n_rows)
+    analyzers = [Size()]
+    for i in range(4):
+        c = f"c{i}"
+        analyzers += [Completeness(c), Mean(c), Minimum(c), Maximum(c)]
+    suites_per_rep = 4
+
+    def run_suites():
+        t0 = time.time()
+        for _ in range(suites_per_rep):
+            ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+        wall = time.time() - t0
+        assert all(m.value.is_success for m in ctx.all_metrics())
+        return wall
+
+    def governed():
+        budget = RunPolicy(
+            run_deadline=600.0, max_total_attempts=1 << 16
+        ).arm()
+        with run_budget_scope(budget):
+            wall = run_suites()
+        return wall, budget
+
+    run_suites()  # warmup: compile the fused program
+    plain = float("inf")
+    with_budget = float("inf")
+    charges_before = SCAN_STATS.budget_charges
+    for _ in range(5):  # interleaved so drift hits both sides alike
+        plain = min(plain, run_suites())
+        wall, budget = governed()
+        with_budget = min(with_budget, wall)
+        assert budget.attempts == 0, (
+            f"healthy run charged the budget: {budget.charges}"
+        )
+    assert SCAN_STATS.budget_charges == charges_before, (
+        "healthy-path scans must not charge the budget ledger"
+    )
+    frac = max(with_budget - plain, 0.0) / max(plain, 1e-9)
+    assert frac < 0.01, (
+        f"governance overhead {frac:.4f} >= 1% of healthy wall "
+        f"(plain={plain*1000:.1f}ms governed={with_budget*1000:.1f}ms)"
+    )
+    return {
+        "governance_overhead_frac": round(frac, 4),
+        "governed_wall_ms": round(with_budget * 1000, 2),
+    }
+
+
 def measure_oom_bisection_overhead(n_rows: int):
     """Device-fault degradation cost probe: the same in-memory analysis
     timed clean vs with a seeded device OOM injected on its first attempt
@@ -609,9 +671,16 @@ def main():
         batch_rows=SMOKE_ROWS // 4 if smoke else 100_000,
     )
     print(f"ingest probe: {ingest_probe}", file=sys.stderr)
+    # run-governance probe (round 9): the healthy config-1 shape under an
+    # armed RunBudget must cost <1% of wall and charge nothing (asserted
+    # inside the probe)
+    governance_probe = measure_governance_overhead(
+        SMOKE_ROWS if smoke else 200_000
+    )
+    print(f"governance probe: {governance_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
-        **lint_probe, **ingest_probe,
+        **lint_probe, **ingest_probe, **governance_probe,
     }
 
     if smoke:

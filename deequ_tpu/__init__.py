@@ -76,6 +76,7 @@ from deequ_tpu.exceptions import (  # noqa: E402
     PeerLostException,
     PlanLintError,
     PlanLintWarning,
+    RunBudgetExhaustedException,
 )
 from deequ_tpu.checks import Check, CheckLevel, CheckStatus  # noqa: E402
 from deequ_tpu.verification import (  # noqa: E402
@@ -115,6 +116,7 @@ __all__ = [
     "MeshDegradedException",
     "PeerLostException",
     "PlanLintError",
+    "RunBudgetExhaustedException",
     "PlanLintWarning",
     "DoubleMetric",
     "Entity",

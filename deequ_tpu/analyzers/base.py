@@ -23,6 +23,7 @@ from deequ_tpu.exceptions import (
     NoSuchColumnException,
     NumberOfSpecifiedColumnsException,
     PlanLintError,
+    RunBudgetExhaustedException,
     WrongColumnTypeException,
     wrap_if_necessary,
 )
@@ -166,6 +167,15 @@ class Analyzer(ABC):
             # surface (the plan_lint="error" contract): planner drift is
             # a programming error, never a data-quality failure metric
             raise
+        except RunBudgetExhaustedException as e:
+            if not e.degraded:
+                # on_budget_exhausted="raise": a run-level outcome must
+                # reach the caller typed, never hide in one analyzer's
+                # failure metric
+                raise
+            # "degrade": complete gracefully as a typed failure metric —
+            # grouping/own-pass states have no row-range partial surface
+            return self.to_failure_metric(e)
         except Exception as e:  # noqa: BLE001
             return self.to_failure_metric(wrap_if_necessary(e))
         return self.calculate_metric(state, aggregate_with, save_states_with)

@@ -43,6 +43,7 @@ from deequ_tpu.exceptions import (
     ReusingNotPossibleResultsMissingException,  # noqa: F401 — canonical home
     # is the exceptions taxonomy; re-exported here for compatibility (the
     # class was born in this module)
+    RunBudgetExhaustedException,
     wrap_if_necessary,
 )
 from deequ_tpu.metrics import DoubleMetric, Metric
@@ -200,6 +201,44 @@ class AnalysisRunner:
         bisect/fallback policy."""
         if not analyzers:
             return AnalyzerContext.empty()
+
+        # run-level governance: when the env vars (DEEQU_TPU_RUN_DEADLINE
+        # / DEEQU_TPU_RUN_ATTEMPTS) arm a budget and no ambient one is
+        # installed (the VerificationSuite entry point installs its own),
+        # arm it HERE, for the whole analysis — otherwise every per-batch
+        # run_scan of a streaming run would resolve the env vars into a
+        # FRESH per-scan budget and the stream would pay per batch again
+        from deequ_tpu.resilience.governance import (
+            current_run_budget,
+            resolve_run_policy,
+            run_budget_scope,
+        )
+
+        if current_run_budget() is None:
+            run_policy = resolve_run_policy()
+            if run_policy is not None:
+                with run_budget_scope(run_policy.arm()):
+                    return AnalysisRunner.do_analysis_run(
+                        data,
+                        analyzers,
+                        aggregate_with=aggregate_with,
+                        save_states_with=save_states_with,
+                        metrics_repository=metrics_repository,
+                        reuse_existing_results_for_key=(
+                            reuse_existing_results_for_key
+                        ),
+                        fail_if_results_missing=fail_if_results_missing,
+                        save_or_append_results_with_key=(
+                            save_or_append_results_with_key
+                        ),
+                        group_memory_budget=group_memory_budget,
+                        checkpoint=checkpoint,
+                        on_batch_error=on_batch_error,
+                        retry_policy=retry_policy,
+                        on_device_error=on_device_error,
+                        device_deadline=device_deadline,
+                        shard_deadline=shard_deadline,
+                    )
 
         analyzers = list(analyzers)
 
@@ -463,6 +502,12 @@ class AnalysisRunner:
             # through VerificationSuite (verification.py docstring)
             # instead of masquerading as per-analyzer failure metrics
             raise
+        except RunBudgetExhaustedException:
+            # run-budget exhaustion is a RUN-level outcome, not one
+            # analyzer's: the caller decides (streaming loop: finalize a
+            # partial result; in-memory: _run_scanning_analyzers records
+            # the unverified range; "raise" mode: propagate typed)
+            raise
         except Exception as e:  # noqa: BLE001 — a failure inside the shared
             # scan maps onto every participating analyzer (reference L320-323)
             wrapped = wrap_if_necessary(e)
@@ -506,14 +551,41 @@ class AnalysisRunner:
         device_deadline=None,
         shard_deadline=None,
     ) -> AnalyzerContext:
-        ctx, scannable, plan, scan = (
-            AnalysisRunner._dispatch_scanning_analyzers(
-                data, analyzers,
-                on_device_error=on_device_error,
-                device_deadline=device_deadline,
-                shard_deadline=shard_deadline,
+        try:
+            ctx, scannable, plan, scan = (
+                AnalysisRunner._dispatch_scanning_analyzers(
+                    data, analyzers,
+                    on_device_error=on_device_error,
+                    device_deadline=device_deadline,
+                    shard_deadline=shard_deadline,
+                )
             )
-        )
+        except RunBudgetExhaustedException as e:
+            if not e.degraded:
+                raise
+            # graceful degradation (on_budget_exhausted="degrade"): the
+            # fused scan could not finish within the run budget, so NONE
+            # of these rows were verified by this pass — report the exact
+            # range on the PR-5 partial-result surface and turn the typed
+            # exception into failure metrics (failure-as-data), letting
+            # the run complete instead of raising mid-ladder
+            from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+            try:
+                total = int(data.num_rows or 0)
+            except Exception:  # noqa: BLE001 — count-less streaming source
+                total = 0
+            if total > 0:
+                SCAN_STATS.record_unverified(
+                    0, total, reason=str(e), kind="budget_exhausted"
+                )
+            else:
+                SCAN_STATS.record_degradation(
+                    "budget_exhausted", reason=str(e)
+                )
+            return AnalyzerContext(
+                {a: a.to_failure_metric(e) for a in analyzers}
+            )
         if scan is None:
             return ctx
         return AnalysisRunner._finalize_scanning_analyzers(
@@ -740,6 +812,11 @@ class AnalysisRunner:
         if columns is not None:
             for g in by_grouping:
                 columns.update(g)
+            if not columns and len(data.schema.column_names):
+                # row-count-only workloads (a lone Size()) prune to ZERO
+                # columns, and a zero-column batch cannot carry its row
+                # count — read one column so batches keep their geometry
+                columns.add(data.schema.column_names[0])
 
         # fingerprint: fold keys + batch geometry + whatever identity the
         # source exposes (file paths, metadata row count) — a checkpoint
@@ -895,6 +972,8 @@ class AnalysisRunner:
                     folders[keys[a]].add(a.compute_state_from(batch))
                 except PlanLintError:
                     raise  # static contract violation: typed, never a metric
+                except RunBudgetExhaustedException:
+                    raise  # run-level outcome: the loop degrades/raises
                 except Exception as e:  # noqa: BLE001
                     failed[a] = a.to_failure_metric(wrap_if_necessary(e))
             for g in by_grouping:
@@ -908,10 +987,13 @@ class AnalysisRunner:
                             is not None,
                         )
                     )
+                except RunBudgetExhaustedException:
+                    raise  # run-level outcome: the loop degrades/raises
                 except Exception as e:  # noqa: BLE001
                     failed_groups[g] = wrap_if_necessary(e)
 
         got_any = start > 0
+        last_seen_idx = start - 1
         try:
             for idx, batch in resilient_batches(
                 lambda i: data.batches_from(i, columns=read_cols),
@@ -922,7 +1004,12 @@ class AnalysisRunner:
                 max_batches=max_batches,
             ):
                 got_any = True
+                # counted only AFTER the fold: if fold_batch dies
+                # mid-batch (e.g. the per-batch scan exhausts the run
+                # budget), batch idx is NOT verified and the degrade
+                # handler's boundary must start at it
                 fold_batch(batch)
+                last_seen_idx = idx
                 n_done = idx + 1
                 ckpt_due = checkpoint is not None and checkpoint.due(n_done)
                 if ckpt_due or len(pending) >= drain_every:
@@ -957,6 +1044,42 @@ class AnalysisRunner:
                 )
                 fold_batch(_empty_table(schema))
             drain_pending()  # tail batches since the last boundary
+        except RunBudgetExhaustedException as e:
+            if not e.degraded:
+                for store in spill_stores:
+                    store.release()
+                raise
+            # graceful degradation (on_budget_exhausted="degrade"): the
+            # composed ladder ran out of run budget mid-stream. The fold
+            # stacks hold every batch verified SO FAR — finalize them
+            # into a PARTIAL result and report the rows never reached as
+            # an exact unverified range (the PR-5 surface) instead of
+            # failing the whole run or burning more attempts.
+            try:
+                # best-effort: scans dispatched before exhaustion can
+                # still materialize without new ladder attempts; any
+                # failure in here already maps to per-analyzer failure
+                # metrics inside drain_pending
+                drain_pending()
+            except Exception:  # noqa: BLE001 — degrade must not re-fail
+                pending.clear()
+            from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+            boundary_idx = max([last_seen_idx] + list(skipped)) + 1
+            row0 = None
+            if batch_rows and known_rows is not None:
+                row0 = min(boundary_idx * int(batch_rows), int(known_rows))
+            if row0 is not None and row0 < int(known_rows):
+                SCAN_STATS.record_unverified(
+                    row0, int(known_rows), reason=str(e),
+                    kind="budget_exhausted",
+                )
+            else:
+                SCAN_STATS.record_degradation(
+                    "budget_exhausted",
+                    reason=str(e),
+                    batches_verified=boundary_idx,
+                )
         except Exception as e:  # noqa: BLE001 — a read failure past
             # retries fails every analyzer of the pass (shared-scan rule);
             # checkpoints written so far remain for the resume, but temp

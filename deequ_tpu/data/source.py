@@ -412,6 +412,14 @@ class CSVBatchSource(BatchSource):
             _restrict_arrow_schema(arrow_schema, names, "CSV header")
         )
         self._arrow_schema = arrow_schema
+        # CSV has no encoding metadata (unlike Parquet): sniff the FIRST
+        # block's cardinality to opt low-cardinality numeric columns into
+        # the encoded ingest plane — the PR-8 follow-up (docs/ingest.md).
+        # LAZY (None = not sniffed yet): the sniff parses a real block,
+        # and a source constructed only for schema introspection must
+        # not pay that I/O up front the way the metadata-only Parquet
+        # detection never does
+        self._encoded: Optional[frozenset] = None
 
     def _infer_schema_streaming(self):
         """One streaming pass over the file, widening each column's type
@@ -485,6 +493,47 @@ class CSVBatchSource(BatchSource):
             convert_options=convert,
         )
 
+    def _sniff_encoded_first_block(self) -> frozenset:
+        """Cardinality sniff over ONE streamed block: numeric columns
+        whose first-block distinct count passes the encoded-ingest
+        density rule (<= 1 dictionary entry per 4 rows, capped at the
+        int16 code space) are reported via ``encoded_column_names`` and
+        re-encoded per batch in ``batches`` — CSV's analogue of the
+        Parquet metadata detection. The rule is re-checked per batch by
+        ``_encode_arrow_batch``, so a column that only LOOKED
+        low-cardinality in the first block demotes to plain
+        mid-stream exactly like a Parquet dictionary overflow."""
+        import pyarrow.compute as pc
+
+        from deequ_tpu.data.table import MAX_ENCODED_CARDINALITY
+
+        numeric = [
+            f.name
+            for f in self._schema
+            if f.dtype in (DType.INTEGRAL, DType.FRACTIONAL)
+        ]
+        if not numeric:
+            return frozenset()
+        reader = self._open(
+            block_rows=1 << 16, pin_schema=self._arrow_schema,
+            include=numeric,
+        )
+        try:
+            block = reader.read_next_batch()
+        except StopIteration:
+            return frozenset()  # header-only file
+        finally:
+            reader.close()
+        cap = min(
+            MAX_ENCODED_CARDINALITY, max(block.num_rows // 4, 1)
+        )
+        out = set()
+        for i, name in enumerate(block.schema.names):
+            distinct = len(pc.unique(block.column(i).drop_null()))
+            if 0 < distinct <= cap:
+                out.add(name)
+        return frozenset(out)
+
     @property
     def schema(self) -> Schema:
         return self._schema
@@ -492,6 +541,12 @@ class CSVBatchSource(BatchSource):
     @property
     def num_rows(self) -> Optional[int]:
         return None  # CSV has no row-count metadata; Size() measures it
+
+    @property
+    def encoded_column_names(self) -> frozenset:
+        if self._encoded is None:
+            self._encoded = self._sniff_encoded_first_block()
+        return self._encoded
 
     def batches(
         self,
@@ -502,19 +557,33 @@ class CSVBatchSource(BatchSource):
 
         from deequ_tpu.data.io import from_arrow
 
+        encoded = self.encoded_column_names  # sniffs on first use
         keep = (
             [n for n in self._schema.column_names if n in set(columns)]
             if columns is not None
             else None
         )
-        rows = batch_rows or self._batch_rows or batch_rows_for_schema(self._schema)
+        rows = batch_rows or self._batch_rows or batch_rows_for_schema(
+            self._schema,
+            encoded=encoded,
+        )
         # pruning happens in the reader: pyarrow skips conversion of
         # excluded columns entirely
         reader = self._open(
             block_rows=rows, pin_schema=self._arrow_schema, include=keep
         )
+        # sniffed low-cardinality columns ride the encoded plane: codes
+        # + dictionary + validity through from_arrow, mirroring the
+        # Parquet path (the density denominator is the full batch size;
+        # CSV has no total-row metadata to bound it by)
+        enc_active = set(
+            encoded if keep is None else encoded & set(keep)
+        )
         for record_batch in reader:
-            yield from_arrow(pa.Table.from_batches([record_batch]))
+            tab = pa.Table.from_batches([record_batch])
+            if enc_active:
+                tab = _encode_arrow_batch(tab, enc_active, rows)
+            yield from_arrow(tab)
 
 
 class TableBatchSource(BatchSource):

@@ -94,6 +94,35 @@ class RetryExhaustedException(MetricCalculationRuntimeException):
         self.__cause__ = cause
 
 
+class RunBudgetExhaustedException(MetricCalculationRuntimeException):
+    """The run-level fault budget (resilience/governance.py) ran out
+    mid-ladder: the COMPOSED retry ladder — I/O retries, OOM bisections,
+    encoded demotions, mesh reshards, CPU fallbacks — charged more
+    attempts than ``max_total_attempts`` allows, or the wall clock passed
+    ``run_deadline``. Raised by ``RunBudget.charge`` at the first charge
+    past the budget, so no rung can keep burning time after the run is
+    over budget.
+
+    ``reason`` is ``"max_total_attempts"`` or ``"run_deadline"``;
+    ``ledger`` is the budget's charge snapshot (what each rung spent);
+    ``degraded`` is True when the governing policy is
+    ``on_budget_exhausted="degrade"`` — the verification layers then
+    convert this into a PARTIAL result (failure metrics for the analyzers
+    the exhausted scan could not finish, exact
+    ``unverified_row_ranges`` for the rows never verified) instead of
+    propagating; under ``"raise"`` it surfaces to the caller typed."""
+
+    def __init__(self, reason: str, ledger: Optional[dict] = None,
+                 degraded: bool = True, detail: str = ""):
+        msg = f"run budget exhausted ({reason})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.reason = reason
+        self.ledger = dict(ledger or {})
+        self.degraded = bool(degraded)
+
+
 class PlanLintError(MetricCalculationException):
     """A static contract violation found in a scan program BEFORE dispatch
     (deequ_tpu/lint/plan_lint.py): the traced jaxpr of a ``ScanPlan``-built

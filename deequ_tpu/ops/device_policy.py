@@ -93,36 +93,94 @@ def default_shard_deadline() -> Optional[float]:
     return val if val > 0 else None
 
 
+class _WatchdogPool:
+    """Reusable daemon workers for deadline-bounded calls.
+
+    Spawning a fresh thread per watchdog-wrapped call costs ~1ms —
+    enough to break the <1% governed-healthy-path contract when the run
+    budget wraps every scan attempt. Workers here park on a per-worker
+    inbox between calls, so the healthy path pays only a queue handoff.
+    A worker whose call TIMED OUT is abandoned (a genuinely hung device
+    call cannot be cancelled from Python, only detected): it is never
+    returned to the idle stack, and exits on its own if the hung call
+    ever finishes. Pool size is bounded by the peak number of
+    concurrently armed watchdogs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._idle: list = []
+
+    def _spawn(self):
+        import queue
+
+        inbox: "queue.SimpleQueue" = queue.SimpleQueue()
+
+        def loop():
+            while True:
+                fn, box, done, state = inbox.get()
+                try:
+                    box["value"] = fn()
+                # deequ-lint: ignore[bare-except] -- watchdog worker forwards the exception to the caller thread via box['error'], re-raised there
+                except BaseException as e:  # noqa: BLE001 — re-raised on
+                    # the caller thread
+                    box["error"] = e
+                done.set()
+                # drop the job references BEFORE parking: an idle worker
+                # must not pin the last call's closure (which can hold a
+                # whole in-memory table) or its result box until the
+                # next job arrives
+                fn = box = done = None
+                with self._lock:
+                    abandoned, state = state["abandoned"], None
+                    if abandoned:
+                        return  # timed out: this thread may be poisoned
+                    self._idle.append(inbox)
+
+        threading.Thread(
+            target=loop, daemon=True, name="deequ-tpu-watchdog"
+        ).start()
+        return inbox
+
+    def call(self, fn: Callable, deadline: float, what: str,
+             boundary: str):
+        with self._lock:
+            inbox = self._idle.pop() if self._idle else None
+        if inbox is None:
+            inbox = self._spawn()
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        state = {"abandoned": False}
+        inbox.put((fn, box, done, state))
+        if not done.wait(deadline):
+            with self._lock:
+                # the worker may have finished at the wire: only abandon
+                # (and raise) if it is still genuinely in flight — the
+                # lock orders this against the worker's requeue decision
+                if not done.is_set():
+                    state["abandoned"] = True
+            if state["abandoned"]:
+                raise DeviceHangException(
+                    f"[{boundary}] {what} exceeded the {deadline:g}s "
+                    "compute watchdog deadline — treating the device as "
+                    "hung",
+                    boundary=boundary,
+                    deadline=deadline,
+                )
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
+
+
+_WATCHDOG_POOL = _WatchdogPool()
+
+
 def _call_with_deadline(fn: Callable, deadline: float, what: str,
                         boundary: str):
-    """Run ``fn`` on a watchdog worker thread; if it does not finish
-    within ``deadline`` seconds, raise DeviceHangException. The blocked
-    thread is a daemon and is abandoned — a genuinely hung device call
-    cannot be cancelled from Python, only *detected*."""
-    box: Dict[str, Any] = {}
-    done = threading.Event()
-
-    def run():
-        try:
-            box["value"] = fn()
-        # deequ-lint: ignore[bare-except] -- watchdog worker forwards the exception to the caller thread via box['error'], re-raised there
-        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
-            box["error"] = e
-        finally:
-            done.set()
-
-    t = threading.Thread(target=run, daemon=True, name="deequ-tpu-watchdog")
-    t.start()
-    if not done.wait(deadline):
-        raise DeviceHangException(
-            f"[{boundary}] {what} exceeded the {deadline:g}s compute "
-            "watchdog deadline — treating the device as hung",
-            boundary=boundary,
-            deadline=deadline,
-        )
-    if "error" in box:
-        raise box["error"]
-    return box.get("value")
+    """Run ``fn`` on a (pooled, reusable) watchdog worker thread; if it
+    does not finish within ``deadline`` seconds, raise
+    DeviceHangException. A timed-out worker is abandoned — a genuinely
+    hung device call cannot be cancelled from Python, only *detected*."""
+    return _WATCHDOG_POOL.call(fn, deadline, what, boundary)
 
 
 def device_call(

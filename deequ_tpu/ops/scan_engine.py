@@ -52,6 +52,7 @@ from deequ_tpu.ops.device_policy import (
     DEVICE_HEALTH,
     MESH_HEALTH,
     default_device_deadline,
+    _call_with_deadline,
     default_shard_deadline,
     device_call,
     install_scan_fault_hook,  # noqa: F401 — re-exported: the seam lives here
@@ -87,6 +88,12 @@ DEFAULT_SCAN_WINDOW = 3
 # fresh one continues — fetches stay O(chunks / capacity), and the f64
 # 'sum' regrouping that restart introduces is ulp-level (docs/numerics.md)
 STREAM_FOLD_CAPACITY = 512
+
+# floor for the budget-derived watchdog deadline: an almost-expired run
+# budget must still give each device call a beat to finish (a 0-second
+# watchdog would convert every healthy dispatch into a spurious hang) —
+# the budget's own wall check then terminates the run typed right after
+MIN_BUDGET_WATCHDOG_SECONDS = 0.05
 
 # in-memory scans with 'gather' leaves size the accumulator to the exact
 # chunk count; past this many chunks they keep the host fold instead —
@@ -317,6 +324,14 @@ class ScanStats:
         # mirroring the PR-6 selection->sort demotion)
         self.encoded_scan_passes = 0
         self.encoded_demotions = 0
+        # run-level governance (resilience/governance.py): ladder/retry
+        # attempts charged against an armed RunBudget (I/O retries, OOM
+        # bisections, encoded demotions, mesh reshards, CPU fallbacks —
+        # one ledger for the composed ladder) and how many runs
+        # exhausted one. Healthy runs charge ZERO — the observable pair
+        # behind bench.py's measure_governance_overhead <1% contract
+        self.budget_charges = 0
+        self.budget_exhaustions = 0
 
     @property
     def ingest_overlap_frac(self) -> float:
@@ -342,14 +357,18 @@ class ScanStats:
         snap["ingest_overlap_frac"] = round(self.ingest_overlap_frac, 4)
         return snap
 
-    def record_unverified(self, start: int, stop: int, reason: str) -> dict:
+    def record_unverified(
+        self, start: int, stop: int, reason: str, kind: str = "peer_lost"
+    ) -> dict:
         """Mark one [start, stop) row range as UNVERIFIED (a degraded
-        multi-host run completed without the lost hosts' shards). The
-        omission is reported, never silent — mirrored onto
+        multi-host run completed without the lost hosts' shards; a
+        budget-exhausted run completed without its remaining rows —
+        ``kind="budget_exhausted"``). The omission is reported, never
+        silent — mirrored onto
         ``VerificationResult.unverified_row_ranges``."""
         self.unverified_row_ranges.append((int(start), int(stop)))
         return self.record_degradation(
-            "peer_lost", start=int(start), stop=int(stop), reason=reason
+            kind, start=int(start), stop=int(stop), reason=reason
         )
 
     def record_fetch(self, nbytes: int) -> None:
@@ -1758,6 +1777,38 @@ def _evict_device_cache(table) -> int:
     return freed
 
 
+def _governed_attempt(budget, fn: Callable, what: str):
+    """Run one WHOLE scan attempt under the run budget's wall watchdog.
+
+    One worker thread per governed attempt — never per device call: the
+    healthy-path cost of governance must stay <1% of wall (bench.py's
+    ``measure_governance_overhead`` contract), and a per-call watchdog
+    measured ~30% on the config-1 profile. A hang anywhere inside the
+    attempt becomes a typed ``DeviceHangException`` at the remaining
+    budget, which the ladder then charges — so termination within
+    ``run_deadline`` holds for hangs, not just exceptions. Ungoverned
+    (or deadline-free) budgets run ``fn`` inline at zero cost.
+
+    The ambient budget is THREAD-LOCAL, so the watchdog worker re-enters
+    the scope explicitly — charge sites inside the attempt (stream-read
+    retries) keep drawing on this run's ledger, and a worker abandoned
+    after a timeout can only ever charge its own (exhausted) budget,
+    never a later run's."""
+    wall_left = budget.remaining_seconds() if budget is not None else None
+    if wall_left is None:
+        return fn()
+    from deequ_tpu.resilience.governance import run_budget_scope
+
+    def governed_fn():
+        with run_budget_scope(budget):
+            return fn()
+
+    return _call_with_deadline(
+        governed_fn, max(wall_left, MIN_BUDGET_WATCHDOG_SECONDS), what,
+        "execute",
+    )
+
+
 def run_scan(
     table,
     ops: Sequence[ScanOp],
@@ -1771,6 +1822,9 @@ def run_scan(
     select_kernel: Optional[bool] = None,
     plan_lint: Optional[str] = None,
     encoded_ingest: Optional[bool] = None,
+    run_deadline: Optional[float] = None,
+    max_total_attempts: Optional[int] = None,
+    on_budget_exhausted: Optional[str] = None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1856,14 +1910,33 @@ def run_scan(
     ``encoded_demote`` degradation event) before any chunk bisection,
     exactly like the selection->sort re-plan.
 
-    ``defer=True`` scans dispatch under the same typed boundaries, but
-    errors surfacing at ``result()`` are past bisection/fallback — the
-    caller holds the only retry point then.
+    Run-level governance (resilience/governance.py): ``run_deadline`` /
+    ``max_total_attempts`` (defaults from ``DEEQU_TPU_RUN_DEADLINE`` /
+    ``DEEQU_TPU_RUN_ATTEMPTS``) arm ONE fault budget for this scan that
+    every rung of the composed ladder charges — I/O retries, OOM
+    bisections, encoded demotions, mesh reshards, CPU fallback
+    transitions. A scan already running under an ambient
+    ``run_budget_scope`` (e.g. one VerificationSuite run spanning many
+    per-batch scans) charges THAT budget instead — the per-scan
+    arguments never stack a second ledger on top. The first charge past
+    the budget raises a typed ``RunBudgetExhaustedException``
+    (``degraded`` flag per ``on_budget_exhausted``); when the budget
+    carries a wall deadline, each WHOLE scan attempt (and the fallback
+    rung, and whole stream scans) additionally runs under one
+    attempt-level watchdog armed with the remaining budget
+    (``_governed_attempt``) so even a hung device call terminates typed
+    within ``run_deadline`` — one worker thread per attempt, so healthy
+    runs stay within the <1% governance-overhead contract.
     """
     from deequ_tpu.lint.plan_lint import plan_lint_mode
     from deequ_tpu.ops.scan_plan import (
         encoded_ingest_enabled,
         select_kernel_enabled,
+    )
+    from deequ_tpu.resilience.governance import (
+        current_run_budget,
+        resolve_run_policy,
+        run_budget_scope,
     )
 
     if on_device_error not in ("fail", "fallback"):
@@ -1871,6 +1944,21 @@ def run_scan(
             f"on_device_error must be 'fail' or 'fallback', "
             f"got {on_device_error!r}"
         )
+    budget = current_run_budget()
+    if budget is None:
+        run_policy = resolve_run_policy(
+            run_deadline, max_total_attempts, on_budget_exhausted
+        )
+        if run_policy is not None:
+            # arm a scan-local budget and re-enter with it ambient, so
+            # every nested charge site (stream-read retries included)
+            # draws on one ledger
+            with run_budget_scope(run_policy.arm()):
+                return run_scan(
+                    table, ops, chunk_rows, mesh, defer, on_device_error,
+                    device_deadline, window, shard_deadline, select_kernel,
+                    plan_lint, encoded_ingest,
+                )
     # resolve (and validate) the selection-kernel switch ONCE per run so
     # every bisection/reshard attempt plans against the same setting
     select_kernel = select_kernel_enabled(select_kernel)
@@ -1908,11 +1996,20 @@ def run_scan(
                 if device_deadline is None
                 else min(device_deadline, shard_deadline)
             )
-        return _run_scan_stream(
-            table, ops, chunk_rows, mesh,
-            scan_id=scan_id, device_deadline=stream_deadline,
-            window=window, select_kernel=select_kernel,
-            plan_lint=plan_lint, encoded=encoded_ingest,
+        # a run budget with a wall deadline bounds the WHOLE stream scan
+        # with one attempt-level watchdog (one worker thread per governed
+        # scan, not per device call — the <1% healthy-path contract): a
+        # hung dispatch becomes a typed DeviceHangException inside
+        # run_deadline
+        return _governed_attempt(
+            budget,
+            lambda: _run_scan_stream(
+                table, ops, chunk_rows, mesh,
+                scan_id=scan_id, device_deadline=stream_deadline,
+                window=window, select_kernel=select_kernel,
+                plan_lint=plan_lint, encoded=encoded_ingest,
+            ),
+            f"stream scan {scan_id} (run budget)",
         )
 
     chunk_override = chunk_rows
@@ -2025,22 +2122,39 @@ def run_scan(
                 # fallback must drop residency or it would dispatch right
                 # back onto the device it is fleeing
                 _evict_device_cache(table)
-                with jax.default_device(_cpu_fallback_device()):
-                    # the watchdog disarms on the fallback attempt: it
-                    # exists to detect a hung ACCELERATOR, and the CPU
-                    # re-jit legitimately pays a fresh compile the
-                    # accelerator deadline was never sized for
-                    return _run_scan_once(
-                        table, ops, chunk_override, None, defer,
-                        None, scan_ctx, report, window,
-                        select_kernel=select_kernel, plan_lint=plan_lint,
-                        encoded=encoded_ingest,
-                    )
-            result = _run_scan_once(
-                table, ops, chunk_override, mesh, defer,
-                attempt_deadline, scan_ctx, report, window,
-                select_kernel=select_kernel, plan_lint=plan_lint,
-                encoded=encoded_ingest,
+
+                def _fallback_once():
+                    # jax.default_device is THREAD-LOCAL: the context
+                    # must open inside the (possibly watchdog-worker)
+                    # thread that runs the attempt. The per-call
+                    # watchdog stays disarmed here — it exists to detect
+                    # a hung ACCELERATOR, and the CPU re-jit
+                    # legitimately pays a fresh compile — but the run
+                    # budget's attempt-level watchdog still bounds the
+                    # whole rung, so termination within run_deadline
+                    # covers the fallback too
+                    with jax.default_device(_cpu_fallback_device()):
+                        return _run_scan_once(
+                            table, ops, chunk_override, None, defer,
+                            None, scan_ctx, report, window,
+                            select_kernel=select_kernel,
+                            plan_lint=plan_lint,
+                            encoded=encoded_ingest,
+                        )
+
+                return _governed_attempt(
+                    budget, _fallback_once,
+                    f"scan {scan_id} CPU fallback (run budget)",
+                )
+            result = _governed_attempt(
+                budget,
+                lambda: _run_scan_once(
+                    table, ops, chunk_override, mesh, defer,
+                    attempt_deadline, scan_ctx, report, window,
+                    select_kernel=select_kernel, plan_lint=plan_lint,
+                    encoded=encoded_ingest,
+                ),
+                f"scan {scan_id} attempt {attempt} (run budget)",
             )
             DEVICE_HEALTH.record_success()
             if n_dev > 1:
@@ -2059,6 +2173,11 @@ def run_scan(
             # retry on the known-good decoded path at the same chunk
             # size; a recurring OOM there bisects as before
             if not fallback and encoded_ingest and report.get("encoded"):
+                # every ladder retry charges the run budget FIRST: an
+                # exhausted budget raises typed here instead of spending
+                # another rung (the charge exception carries the ledger)
+                if budget is not None:
+                    budget.charge("encoded_demote", scan_id=scan_id)
                 encoded_ingest = False
                 SCAN_STATS.encoded_demotions += 1
                 SCAN_STATS.record_degradation(
@@ -2070,6 +2189,8 @@ def run_scan(
             halved = max(floor, used // 2)
             halved = max(n_dev, (halved // n_dev) * n_dev)
             if halved < used and not fallback:
+                if budget is not None:
+                    budget.charge("oom_bisect", scan_id=scan_id)
                 depth += 1
                 SCAN_STATS.oom_bisections += 1
                 SCAN_STATS.bisection_depth = max(
@@ -2087,10 +2208,14 @@ def run_scan(
             # its device) can still shed the sick member and retry on the
             # healthy remainder before any CPU fallback
             if not fallback and _reshard_after(e):
+                if budget is not None:
+                    budget.charge("mesh_reshard", scan_id=scan_id)
                 attempt += 1
                 continue
             # bisection and resharding cannot help any further
             if can_fallback and not fallback:
+                if budget is not None:
+                    budget.charge("cpu_fallback", scan_id=scan_id)
                 fallback = True
                 attempt += 1
                 SCAN_STATS.record_degradation(
@@ -2129,6 +2254,8 @@ def run_scan(
             # largest healthy subset, and the CPU fallback is reached only
             # when no accelerator subset remains
             if not fallback and _reshard_after(e):
+                if budget is not None:
+                    budget.charge("mesh_reshard", scan_id=scan_id)
                 attempt += 1
                 continue
             if not fallback:  # CPU-side faults are not accelerator health
@@ -2137,6 +2264,8 @@ def run_scan(
             # the same program on the same backend cannot help — fall
             # back or raise typed
             if can_fallback and not fallback:
+                if budget is not None:
+                    budget.charge("cpu_fallback", scan_id=scan_id)
                 fallback = True
                 attempt += 1
                 SCAN_STATS.record_degradation(
@@ -2808,21 +2937,32 @@ def _prefetch(iterator, depth: int = 2):
     import queue
     import threading
 
+    from deequ_tpu.resilience.governance import (
+        current_run_budget,
+        run_budget_scope,
+    )
+
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     DONE = object()
     stop = threading.Event()
+    # the ambient run budget is thread-local: re-install it on the
+    # reader thread so the source's retry layer keeps charging THIS
+    # run's ledger (stream reads are the one charge site that executes
+    # over here)
+    budget = current_run_budget()
 
     def run():
         try:
-            for item in iterator:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
-                    return
+            with run_budget_scope(budget):
+                for item in iterator:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             while not stop.is_set():
                 try:
                     q.put(DONE, timeout=0.1)
@@ -2952,7 +3092,15 @@ def _run_scan_stream(
     re-read. Streaming runs wanting per-batch device-fault recovery go
     through the runner's resilient loop (``on_device_error`` /
     ``on_batch_error`` / ``checkpoint``), which scans each batch as an
-    in-memory table under the full policy."""
+    in-memory table under the full policy.
+
+    Run-budget audit (round 9): this function performs NO retries of its
+    own — its only retry sites are the source's batch reads
+    (``RetryingBatchSource``/``resilient_batches``, which charge the
+    AMBIENT run budget per failed try) and, on the resilient-loop path,
+    the per-batch ``run_scan`` ladders (which resolve the same ambient
+    budget). Either way a stream draws on ONE ``max_total_attempts``,
+    never a fresh budget per batch."""
     from deequ_tpu.ops.scan_plan import plan_scan_ops
 
     # streaming chunks are never resident: the planner keeps the sort
@@ -2964,6 +3112,12 @@ def _run_scan_stream(
     ops = plan_ir.ops
     needed = sorted({c for op in ops for c in op.columns})
     schema = stream.schema
+    if not needed and len(schema.column_names):
+        # row-count-only workloads (a lone Size()) prune to ZERO
+        # columns, and a zero-column batch cannot carry its row count
+        # (ColumnarTable([]).num_rows == 0) — the scan would silently
+        # fold 0 rows. Read one column so every batch keeps its geometry.
+        needed = [schema.column_names[0]]
     dtypes = {n: schema[n].dtype for n in needed}
     n_dev = math.prod(mesh.devices.shape) if mesh is not None else 1
     # chunk size = the user's batch budget when the source has one, else a

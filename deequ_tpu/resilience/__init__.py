@@ -17,7 +17,17 @@ transient reads, torn writes, and mid-stream crashes:
 - :mod:`deequ_tpu.resilience.faults` — the deterministic seeded
   fault-injection harness (``FaultInjectingFileSystem``,
   ``FlakyBatchSource``, and the device-fault ``FaultInjectingScanHook``)
-  the resilience test suites drive.
+  the resilience test suites drive;
+- :mod:`deequ_tpu.resilience.governance` — run-level fault governance:
+  ``RunPolicy``/``RunBudget`` (one deadline/attempt ledger for the whole
+  composed ladder, with graceful degradation to partial results) and
+  ``fault_state_scope`` (isolation of the process-wide fault
+  singletons);
+- :mod:`deequ_tpu.resilience.chaos` — the deterministic chaos engine:
+  seeded fault SCHEDULES composing every injector seam into one
+  timeline, invariant oracles checked after each run, and a
+  delta-debugging shrinker producing minimal replayable reproducers
+  (``python -m deequ_tpu.resilience.chaos --soak``).
 
 Device-side fault tolerance (the XLA error taxonomy, OOM chunk
 bisection, the CPU fallback, and the compute watchdog) lives in
@@ -51,6 +61,20 @@ from deequ_tpu.resilience.checkpoint import (
     StreamCheckpoint,
     StreamCheckpointer,
     run_fingerprint,
+)
+from deequ_tpu.exceptions import (  # noqa: F401 — canonical home is exceptions
+    RunBudgetExhaustedException,
+)
+from deequ_tpu.resilience.governance import (
+    RunBudget,
+    RunPolicy,
+    charge_run_budget,
+    current_run_budget,
+    default_max_total_attempts,
+    default_run_deadline,
+    fault_state_scope,
+    resolve_run_policy,
+    run_budget_scope,
 )
 from deequ_tpu.resilience.faults import (
     FaultInjectingFileSystem,
@@ -86,6 +110,16 @@ __all__ = [
     "classify_device_error",
     "implicated_devices",
     "RetryExhaustedException",
+    "RunBudgetExhaustedException",
+    "RunBudget",
+    "RunPolicy",
+    "run_budget_scope",
+    "current_run_budget",
+    "charge_run_budget",
+    "resolve_run_policy",
+    "default_run_deadline",
+    "default_max_total_attempts",
+    "fault_state_scope",
     "RETRY_TELEMETRY",
     "RetryTelemetry",
     "RetryPolicy",

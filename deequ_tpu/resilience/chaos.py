@@ -1,0 +1,920 @@
+"""Deterministic chaos engine — seeded fault schedules + invariant oracles.
+
+The fault ladder is six rungs deep (I/O retry -> quarantine -> OOM bisect
+-> encoded demote -> mesh reshard -> CPU fallback) and, until now, every
+injector seam was exercised one at a time by hand-written tests. The only
+credible way to trust the ladder under COMBINED faults is to fuzz it:
+generate a seeded, replayable :class:`ChaosSchedule` that scripts every
+existing injector seam into one timeline —
+
+- ``scan``  — device faults at the scan engine's execute seam
+  (``FaultInjectingScanHook``: oom / compile / lost / hang, optionally
+  pinned to one mesh member the way per-chip XLA failures name chips);
+- ``batch`` — transient/permanent batch-read faults
+  (``FlakyBatchSource`` + ``FaultSchedule``);
+- ``staging`` — slow reads stalling the ingest/staging pipeline
+  (``FaultSchedule.delay_seconds``);
+- ``fs``    — seeded I/O faults on the checkpoint filesystem
+  (``FaultInjectingFileSystem``; the schedule's fs event also switches
+  the run to checkpointed mode so the persistence seam is in play) —
+
+run one governed verification under it (``on_batch_error="skip"``,
+``on_device_error="fallback"``, a `RunPolicy` budget), and then check the
+system's OWN cross-cutting invariants as oracles:
+
+1. typed outcome — the run returns a result or raises from the
+   MetricCalculationException taxonomy; never a raw error;
+2. termination — wall clock bounded by ``run_deadline`` (+ slack for
+   host overhead);
+3. bit-identity-or-degraded — every successful metric equals, bit for
+   bit, the fault-free reference over exactly the rows the result claims
+   verified (full table, or total minus quarantined batches minus
+   ``unverified_row_ranges``); failure metrics must be typed;
+4. row accounting — unverified ranges well-formed, batch-aligned, and
+   disjoint from quarantined batches;
+5. fetch contract — device fetches never exceed scan passes (the PR-4
+   one-fetch discipline under the fault ladder);
+6. HBM ledger — ``total_resident_bytes()`` returns to zero;
+7. ledger consistency — quarantined batches all trace to injected
+   faults; the run budget's total equals the sum of its per-rung
+   charges; its ``io_retry`` charges equal the run's retry-telemetry
+   attempts.
+
+A failing schedule is reduced by :func:`shrink_schedule` — classic
+delta debugging (ddmin) over the event list, re-running the oracles per
+candidate — to a minimal reproducer serializable as a JSON fixture
+(``tests/fixtures/chaos/``) that tier-1 replays bit-identically.
+``simulate_drift=True`` deliberately perturbs the results of a faulted
+run (a stand-in for a ladder bug that breaks recovery bit-identity), so
+the oracle->shrink loop itself is testable end to end.
+
+CLI::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m deequ_tpu.resilience.chaos --soak --n 200
+
+runs N seeded schedules and exits nonzero on any oracle violation.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: scenario geometry — small enough that one schedule runs in ~a second
+#: on the 8-virtual-device CPU mesh, large enough for several batches
+N_ROWS = 1600
+BATCH_ROWS = 400
+TABLE_SEED = 11
+
+#: injected hangs sleep this long, then RAISE (hang_release="error") —
+#: self-terminating, so chaos runs need no per-call device watchdog. A
+#: tight per-call deadline on this loaded CPU emulation fires spuriously
+#: on healthy 8-device dispatches, and the abandoned worker then
+#: deadlocks the shared collective thread pool against the next dispatch
+#: (a CPU-backend artifact; disjoint device sets run independently on
+#: real hardware). Termination is still bounded: the run budget's
+#: attempt-level watchdog covers genuinely-stuck attempts.
+HANG_SECONDS = 0.6
+
+#: wall-clock slack the termination oracle grants over run_deadline
+#: (host-side packing/trace work is not budget-preemptible)
+TERMINATION_SLACK = 2.0
+
+_SCAN_KINDS = ("oom", "compile", "lost", "hang")
+_SEAMS = ("scan", "batch", "staging", "fs")
+
+
+def _fast_retry():
+    from deequ_tpu.resilience.retry import RetryPolicy
+
+    return RetryPolicy(max_attempts=3, base_delay=0.0005, max_delay=0.002)
+
+
+# -- schedule ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ChaosSchedule:
+    """One seeded, serializable fault timeline over the fixed scenario.
+
+    ``events`` is a list of plain dicts (see the module docstring's seam
+    catalog) — the unit the shrinker removes. Two runs of the same
+    schedule inject the identical fault pattern (``FaultSchedule`` /
+    ``FaultInjectingScanHook`` are pure functions of (seed, operation
+    sequence)), which is what makes shrunk reproducers replayable."""
+
+    seed: int
+    events: Tuple[dict, ...] = ()
+    run_deadline: float = 20.0
+    max_total_attempts: int = 12
+    on_budget_exhausted: str = "degrade"
+
+    @property
+    def n_batches(self) -> int:
+        return (N_ROWS + BATCH_ROWS - 1) // BATCH_ROWS
+
+    def with_events(self, events) -> "ChaosSchedule":
+        return ChaosSchedule(
+            seed=self.seed,
+            events=tuple(dict(e) for e in events),
+            run_deadline=self.run_deadline,
+            max_total_attempts=self.max_total_attempts,
+            on_budget_exhausted=self.on_budget_exhausted,
+        )
+
+    # -- (de)serialization — the fixture format --------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "seed": self.seed,
+            "events": [dict(e) for e in self.events],
+            "run_deadline": self.run_deadline,
+            "max_total_attempts": self.max_total_attempts,
+            "on_budget_exhausted": self.on_budget_exhausted,
+        }
+
+    def to_json(self) -> str:
+        # math.inf serializes as the JSON extension literal Infinity,
+        # which json.loads round-trips — permanent faults survive disk
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_dict(raw: dict) -> "ChaosSchedule":
+        return ChaosSchedule(
+            seed=int(raw["seed"]),
+            events=tuple(dict(e) for e in raw.get("events", ())),
+            run_deadline=float(raw.get("run_deadline", 20.0)),
+            max_total_attempts=int(raw.get("max_total_attempts", 12)),
+            on_budget_exhausted=str(
+                raw.get("on_budget_exhausted", "degrade")
+            ),
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "ChaosSchedule":
+        return ChaosSchedule.from_dict(json.loads(text))
+
+    # -- generation ------------------------------------------------------
+
+    @staticmethod
+    def generate(seed: int) -> "ChaosSchedule":
+        """Seeded schedule: 1-4 events drawn across the four seams, a
+        run budget sized so that most schedules complete but heavy ones
+        exhaust it (both outcomes are oracle-checked)."""
+        from deequ_tpu.resilience.faults import FaultSchedule
+
+        rng = Random(seed)
+        n_batches = (N_ROWS + BATCH_ROWS - 1) // BATCH_ROWS
+        events: List[dict] = []
+        for _ in range(1 + rng.randrange(3)):
+            seam = rng.choice(("scan", "scan", "batch", "batch", "fs"))
+            if seam == "scan":
+                kind = rng.choice(_SCAN_KINDS)
+                times = (
+                    FaultSchedule.PERMANENT
+                    if rng.random() < 0.15
+                    else 1 + rng.randrange(3)
+                )
+                device = (
+                    rng.randrange(8) if rng.random() < 0.3 else None
+                )
+                events.append(
+                    {
+                        "seam": "scan",
+                        "scan": rng.randrange(n_batches),
+                        "kind": kind,
+                        "times": times,
+                        "device": device,
+                    }
+                )
+            elif seam == "batch":
+                times = (
+                    FaultSchedule.PERMANENT
+                    if rng.random() < 0.25
+                    else 1 + rng.randrange(2)
+                )
+                events.append(
+                    {
+                        "seam": "batch",
+                        "index": rng.randrange(n_batches),
+                        "times": times,
+                    }
+                )
+            else:
+                events.append(
+                    {"seam": "fs", "rate": round(0.05 + rng.random() * 0.1, 3)}
+                )
+        if rng.random() < 0.25:
+            events.append(
+                {
+                    "seam": "staging",
+                    "seconds": round(0.002 + rng.random() * 0.01, 4),
+                    "rate": round(0.2 + rng.random() * 0.5, 3),
+                }
+            )
+        return ChaosSchedule(
+            seed=seed,
+            events=tuple(events),
+            run_deadline=20.0,
+            max_total_attempts=6 + rng.randrange(9),
+            on_budget_exhausted=(
+                "raise" if rng.random() < 0.15 else "degrade"
+            ),
+        )
+
+
+# -- scenario ----------------------------------------------------------------
+
+
+def _build_table():
+    """Deterministic scenario table. Values are INTEGER-valued floats so
+    every fold sum is exact in f64 regardless of merge order — the
+    bit-identity oracle then holds across any chunking/bisection path
+    the ladder takes."""
+    import numpy as np
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+
+    rng = np.random.default_rng(TABLE_SEED)
+    n = N_ROWS
+    val = rng.integers(0, 1000, n).astype(np.float64)
+    val_mask = np.ones(n, dtype=np.bool_)
+    val_mask[rng.integers(0, n, n // 50)] = False
+    cat = rng.integers(0, 8, n)
+    return ColumnarTable(
+        [
+            Column(
+                "id", DType.INTEGRAL,
+                values=np.arange(n, dtype=np.int64),
+                mask=np.ones(n, dtype=np.bool_),
+            ),
+            Column("val", DType.FRACTIONAL, values=val, mask=val_mask),
+            Column(
+                "cat", DType.INTEGRAL, values=cat,
+                mask=np.ones(n, dtype=np.bool_),
+            ),
+        ]
+    )
+
+
+def _analyzers():
+    """Analyzers whose fold algebra is EXACTLY associative on this
+    integer-valued table (sums below 2^53, min/max, HLL register max):
+    bit-identity then holds across ANY chunking/bisection/reshard path
+    the ladder takes, which is what oracle 3 asserts. Welford-moment
+    analyzers (StandardDeviation's (n, avg, m2) merge) are deliberately
+    excluded — their merge is partition-sensitive at ulp scale by
+    design (docs/numerics.md), so they cannot promise bit-identity
+    across a bisected re-chunk and would fuzz the oracle."""
+    from deequ_tpu.analyzers import (
+        ApproxCountDistinct,
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        Sum,
+    )
+
+    return [
+        Size(),
+        Completeness("val"),
+        Mean("val"),
+        Minimum("val"),
+        Maximum("val"),
+        ApproxCountDistinct("cat"),
+        Sum("cat"),
+    ]
+
+
+def _check():
+    from deequ_tpu.checks import Check, CheckLevel
+
+    return Check(CheckLevel.ERROR, "chaos scenario").has_size(
+        lambda s: s >= 0
+    )
+
+
+def _batch_slices(table, indices):
+    """The scenario's batch partition: batch i = rows
+    [i*BATCH_ROWS, min((i+1)*BATCH_ROWS, N_ROWS))."""
+    import numpy as np
+
+    out = []
+    for i in indices:
+        lo, hi = i * BATCH_ROWS, min((i + 1) * BATCH_ROWS, N_ROWS)
+        idx = np.arange(lo, hi)
+        out.append(
+            type(table)([table[c].take(idx) for c in table.column_names])
+        )
+    return out
+
+
+def _metric_rows(result) -> Dict[str, tuple]:
+    """str(analyzer) -> ("ok", float) | ("fail", ExceptionTypeName)."""
+    out = {}
+    for analyzer, metric in result.metrics.items():
+        if metric.value.is_success:
+            out[str(analyzer)] = ("ok", metric.value.get())
+        else:
+            out[str(analyzer)] = (
+                "fail", type(metric.value.exception).__name__,
+            )
+    return out
+
+
+#: fault-free reference metrics per batch subset: the reference is a
+#: pure, deterministic function of the fixed scenario and the batch
+#: indices it covers (replay-determinism is separately asserted by the
+#: fixture corpus), so a 200-schedule soak computes each distinct
+#: partition once instead of once per schedule
+_REF_CACHE: Dict[Tuple[int, ...], Dict[str, tuple]] = {}
+
+
+def _reference_metrics(batches, num_rows, cache_key=None) -> Dict[str, tuple]:
+    """Fault-free metrics over exactly ``batches`` through the SAME
+    resilient per-batch pipeline the chaos run uses, so fold order — and
+    therefore bits — match. Runs inside its own fault_state_scope;
+    memoized per ``cache_key`` (the covered batch indices)."""
+    from deequ_tpu.data.source import GeneratorBatchSource
+    from deequ_tpu.data.streaming import StreamingTable
+    from deequ_tpu.resilience.governance import fault_state_scope
+    from deequ_tpu.verification import VerificationSuite
+
+    if cache_key is not None and cache_key in _REF_CACHE:
+        return _REF_CACHE[cache_key]
+    if not batches:
+        return {}
+    schema = batches[0].schema
+    source = GeneratorBatchSource(
+        schema, lambda: iter(list(batches)), num_rows=num_rows
+    )
+    with fault_state_scope():
+        result = VerificationSuite.do_verification_run(
+            StreamingTable(source),
+            [_check()],
+            _analyzers(),
+            on_batch_error="skip",
+            on_device_error="fallback",
+            retry_policy=_fast_retry(),
+        )
+    out = _metric_rows(result)
+    if cache_key is not None:
+        _REF_CACHE[cache_key] = out
+    return out
+
+
+# -- the run -----------------------------------------------------------------
+
+
+@dataclass
+class ChaosReport:
+    """One schedule's run + oracle verdicts."""
+
+    schedule: ChaosSchedule
+    outcome: str  # "identical" | "degraded" | "exception:<Type>"
+    violations: List[str] = field(default_factory=list)
+    elapsed: float = 0.0
+    metrics: Dict[str, tuple] = field(default_factory=dict)
+    skipped: List[int] = field(default_factory=list)
+    unverified: List[tuple] = field(default_factory=list)
+    run_budget: dict = field(default_factory=dict)
+    retry_stats: dict = field(default_factory=dict)
+    scan_delta: dict = field(default_factory=dict)
+    injected: List[tuple] = field(default_factory=list)
+    resident_after: int = 0
+    drifted: bool = False
+
+    @property
+    def failing(self) -> bool:
+        return bool(self.violations)
+
+
+def _install_fs_events(events, seed):
+    """Register the ``chaosfs://`` scheme backed by a fault-injecting
+    in-memory filesystem when the schedule has fs events. Returns
+    (checkpoint_path, fs_schedule, restore_fn)."""
+    from deequ_tpu.data.fs import _REGISTRY, register_filesystem
+    from deequ_tpu.data.fs import InMemoryFileSystem
+    from deequ_tpu.resilience.faults import (
+        FaultInjectingFileSystem,
+        FaultSchedule,
+    )
+
+    rates = [e["rate"] for e in events if e.get("seam") == "fs"]
+    if not rates:
+        return None, None, lambda: None
+    fs_schedule = FaultSchedule(seed=seed, error_rate=max(rates))
+    fs = FaultInjectingFileSystem(InMemoryFileSystem(), fs_schedule)
+    prev = _REGISTRY.get("chaosfs")
+    register_filesystem("chaosfs", lambda path: fs)
+
+    def restore():
+        if prev is None:
+            _REGISTRY.pop("chaosfs", None)
+        else:
+            _REGISTRY["chaosfs"] = prev
+
+    return "chaosfs://chaos/ck", fs_schedule, restore
+
+
+def run_schedule(
+    schedule: ChaosSchedule, simulate_drift: bool = False
+) -> ChaosReport:
+    """Run one schedule end to end: fault-free reference, chaos run under
+    the composed injectors + run budget, then every invariant oracle.
+
+    ``simulate_drift=True`` is the deliberately-broken-ladder mode: when
+    any fault was injected, the run's successful metrics are perturbed
+    by one ulp-scale epsilon before oracle checking — simulating a
+    recovery path that silently loses bit-identity — so the oracles (and
+    the shrinker on top of them) can be shown to catch a real ladder
+    regression."""
+    from deequ_tpu.data.source import TableBatchSource
+    from deequ_tpu.data.streaming import StreamingTable
+    from deequ_tpu.ops.device_policy import install_scan_fault_hook
+    from deequ_tpu.ops.scan_engine import SCAN_STATS, total_resident_bytes
+    from deequ_tpu.resilience.faults import (
+        FaultInjectingScanHook,
+        FaultSchedule,
+        FlakyBatchSource,
+    )
+    from deequ_tpu.resilience.governance import fault_state_scope
+    from deequ_tpu.verification import VerificationSuite
+
+    table = _build_table()
+    n_batches = schedule.n_batches
+
+    # fault-free reference over the full batch partition (same pipeline,
+    # same fold order; memoized — every schedule shares it)
+    ref = _reference_metrics(
+        _batch_slices(table, range(n_batches)), N_ROWS,
+        cache_key=tuple(range(n_batches)),
+    )
+
+    # compose the schedule's events into the injector seams
+    batch_fail = {
+        ("batch", int(e["index"])): float(e["times"])
+        for e in schedule.events
+        if e["seam"] == "batch"
+    }
+    staging = [e for e in schedule.events if e["seam"] == "staging"]
+    batch_schedule = FaultSchedule(
+        seed=schedule.seed,
+        fail=batch_fail,
+        delay_seconds=max((e["seconds"] for e in staging), default=0.0),
+        delay_rate=max((e["rate"] for e in staging), default=1.0),
+    )
+    scan_faults = {}
+    for e in schedule.events:
+        if e["seam"] != "scan":
+            continue
+        scan_faults[int(e["scan"])] = (
+            e["kind"],
+            float(e["times"]),
+            None if e.get("device") is None else int(e["device"]),
+        )
+    # hang_release="error": a hung call eventually surfaces UNAVAILABLE
+    # instead of silently dispatching its stale program — on the CPU
+    # test backend an abandoned worker's late mesh dispatch would
+    # deadlock the shared collective thread pool against the resharded
+    # mesh (see FaultInjectingScanHook docs)
+    hook = FaultInjectingScanHook(
+        scan_faults, hang_seconds=HANG_SECONDS, relative=True,
+        hang_release="error",
+    )
+    ckpt, fs_schedule, restore_fs = _install_fs_events(
+        schedule.events, schedule.seed
+    )
+
+    stream = StreamingTable(
+        FlakyBatchSource(
+            TableBatchSource(table, BATCH_ROWS), batch_schedule
+        )
+    )
+
+    result = None
+    exc: Optional[BaseException] = None
+    scan_before = SCAN_STATS.snapshot()
+    try:
+        with fault_state_scope():
+            install_scan_fault_hook(hook)
+            t0 = time.monotonic()
+            try:
+                result = VerificationSuite.do_verification_run(
+                    stream,
+                    [_check()],
+                    _analyzers(),
+                    on_batch_error="skip",
+                    on_device_error="fallback",
+                    retry_policy=_fast_retry(),
+                    checkpoint=ckpt,
+                    run_deadline=schedule.run_deadline,
+                    max_total_attempts=schedule.max_total_attempts,
+                    on_budget_exhausted=schedule.on_budget_exhausted,
+                )
+            # deequ-lint: ignore[bare-except] -- the chaos driver's whole job is to observe ANY outcome; oracle 1 re-checks that it was typed
+            except Exception as e:  # noqa: BLE001
+                exc = e
+            elapsed = time.monotonic() - t0
+    finally:
+        # even a BaseException escaping the run (KeyboardInterrupt) must
+        # not leave the fault-injecting chaosfs:// scheme registered
+        restore_fs()
+    scan_after = SCAN_STATS.snapshot()
+
+    injected = list(hook.injected) + list(batch_schedule.injected)
+    if fs_schedule is not None:
+        injected += list(fs_schedule.injected)
+
+    report = ChaosReport(
+        schedule=schedule,
+        outcome=(
+            f"exception:{type(exc).__name__}"
+            if exc is not None
+            else (
+                "degraded"
+                if (result.skipped_batches or result.unverified_row_ranges)
+                else "identical"
+            )
+        ),
+        elapsed=elapsed,
+        metrics=_metric_rows(result) if result is not None else {},
+        skipped=list(result.skipped_batches) if result is not None else [],
+        unverified=(
+            [tuple(r) for r in result.unverified_row_ranges]
+            if result is not None
+            else []
+        ),
+        run_budget=dict(result.run_budget) if result is not None else {},
+        retry_stats=dict(result.retry_stats) if result is not None else {},
+        scan_delta={
+            k: scan_after[k] - scan_before[k]
+            for k in (
+                "scan_passes",
+                "device_fetches",
+                "budget_charges",
+                "budget_exhaustions",
+            )
+        },
+        injected=injected,
+        resident_after=total_resident_bytes(),
+    )
+
+    if simulate_drift and injected and report.metrics:
+        # deliberately-broken-ladder mode: nudge every successful metric
+        # the way a recovery path that re-reads rows (or drops them)
+        # would — the bit-identity oracle must catch this
+        report.drifted = True
+        report.metrics = {
+            k: ("ok", v + 1e-9) if status == "ok" else (status, v)
+            for k, (status, v) in report.metrics.items()
+        }
+
+    report.violations = _check_oracles(report, ref, exc, table)
+    return report
+
+
+# -- oracles -----------------------------------------------------------------
+
+
+def _check_oracles(
+    report: ChaosReport, ref: Dict[str, tuple], exc, table
+) -> List[str]:
+    from deequ_tpu.exceptions import MetricCalculationException
+
+    v: List[str] = []
+    schedule = report.schedule
+
+    # 1. typed outcome
+    if exc is not None and not isinstance(exc, MetricCalculationException):
+        v.append(
+            f"untyped outcome: {type(exc).__name__}: {exc}"
+        )
+
+    # 2. termination within the run deadline (+ host slack)
+    if report.elapsed > schedule.run_deadline * 1.5 + TERMINATION_SLACK:
+        v.append(
+            f"termination: {report.elapsed:.2f}s exceeded "
+            f"run_deadline={schedule.run_deadline:g}s (+slack)"
+        )
+
+    # 5. HBM ledger returns to zero (nothing persisted may survive a
+    # chaos run; bisection/fallback evictions must balance the ledger)
+    if report.resident_after != 0:
+        v.append(
+            f"hbm ledger: {report.resident_after} resident bytes after "
+            "the run"
+        )
+
+    if exc is not None:
+        return v  # the remaining oracles compare a RESULT
+
+    n_batches = schedule.n_batches
+
+    # 4. row accounting: unverified ranges well-formed + batch-aligned,
+    # quarantined indices valid, and the two never overlap
+    skipped_rows = set()
+    for i in report.skipped:
+        if not (0 <= i < n_batches):
+            v.append(f"quarantine: skipped batch {i} out of range")
+            continue
+        skipped_rows.update(
+            range(i * BATCH_ROWS, min((i + 1) * BATCH_ROWS, N_ROWS))
+        )
+    if len(set(report.skipped)) != len(report.skipped):
+        v.append("quarantine: duplicate skipped indices")
+    unverified_rows = set()
+    prev_stop = -1
+    for start, stop in sorted(report.unverified):
+        if not (0 <= start < stop <= N_ROWS):
+            v.append(f"row accounting: malformed range ({start}, {stop})")
+            continue
+        if start < prev_stop:
+            v.append("row accounting: overlapping unverified ranges")
+        prev_stop = stop
+        if start % BATCH_ROWS != 0:
+            v.append(
+                f"row accounting: range start {start} not batch-aligned"
+            )
+        unverified_rows.update(range(start, stop))
+    if skipped_rows & unverified_rows:
+        v.append(
+            "row accounting: quarantined rows double-counted as "
+            "unverified"
+        )
+
+    # 7a. quarantine consistency: every skipped batch traces to an
+    # injected fault on that index
+    injected_batches = {
+        key[1]
+        for (kind, key, _attempt) in (
+            t for t in report.injected if len(t) == 3 and t[0] == "ioerror"
+        )
+        if isinstance(key, tuple) and key and key[0] == "batch"
+    }
+    for i in report.skipped:
+        if i not in injected_batches:
+            v.append(
+                f"quarantine: batch {i} skipped without an injected fault"
+            )
+
+    # 7b. budget ledger consistency
+    budget = report.run_budget
+    if budget:
+        charges = dict(budget.get("charges") or {})
+        if budget.get("attempts") != sum(charges.values()):
+            v.append(
+                f"budget ledger: attempts={budget.get('attempts')} != "
+                f"sum(charges)={sum(charges.values())}"
+            )
+        cap = budget.get("max_total_attempts")
+        if (
+            cap is not None
+            and budget.get("exhausted") is None
+            and budget.get("attempts", 0) > cap
+        ):
+            v.append("budget ledger: over cap without exhaustion")
+        io_charged = charges.get("io_retry", 0)
+        io_observed = report.retry_stats.get("attempts", 0)
+        if io_charged != io_observed:
+            v.append(
+                f"budget ledger: io_retry charges ({io_charged}) != "
+                f"retry telemetry attempts ({io_observed})"
+            )
+
+    # 6. fetch contract: at most one device->host fetch per scan pass
+    # (the PR-4 discipline, preserved by every ladder rung)
+    if report.scan_delta.get("device_fetches", 0) > report.scan_delta.get(
+        "scan_passes", 0
+    ):
+        v.append(
+            "fetch contract: "
+            f"{report.scan_delta['device_fetches']} fetches > "
+            f"{report.scan_delta['scan_passes']} scan passes"
+        )
+
+    # 3. bit-identity or exact degradation: successful metrics must equal
+    # the fault-free reference over EXACTLY the verified rows; failure
+    # metrics must be typed
+    verified_batches = [
+        i
+        for i in range(n_batches)
+        if i not in set(report.skipped)
+        and not (
+            unverified_rows
+            & set(range(i * BATCH_ROWS, min((i + 1) * BATCH_ROWS, N_ROWS)))
+        )
+    ]
+    if len(verified_batches) == n_batches:
+        expected = ref
+    else:
+        surviving = _batch_slices(table, verified_batches)
+        expected = _reference_metrics(
+            surviving, sum(b.num_rows for b in surviving),
+            cache_key=tuple(verified_batches),
+        )
+    for name, (status, value) in report.metrics.items():
+        if status == "fail":
+            # typed-failure names come from the taxonomy; anything else
+            # leaked an unclassified error into a metric
+            if not (
+                value.endswith("Exception") or value.endswith("Error")
+            ):
+                v.append(f"metric {name}: suspicious failure type {value}")
+            continue
+        exp = expected.get(name)
+        if exp is None:
+            v.append(f"metric {name}: no reference value")
+        elif exp[0] != "ok":
+            v.append(
+                f"metric {name}: reference failed ({exp[1]}) but chaos "
+                "run succeeded"
+            )
+        elif not _bit_identical(value, exp[1]):
+            v.append(
+                f"metric {name}: {value!r} != reference {exp[1]!r} over "
+                f"verified rows (batches {verified_batches})"
+            )
+    return v
+
+
+def _bit_identical(a, b) -> bool:
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) and math.isnan(b):
+            return True
+        return a == b and math.copysign(1.0, a) == math.copysign(1.0, b)
+    return a == b
+
+
+# -- shrinker ----------------------------------------------------------------
+
+
+def shrink_schedule(
+    schedule: ChaosSchedule,
+    failing: Optional[Callable[[ChaosSchedule], bool]] = None,
+    simulate_drift: bool = False,
+    max_runs: int = 48,
+) -> Tuple[ChaosSchedule, int]:
+    """Delta-debug a failing schedule down to a minimal reproducer.
+
+    Classic ddmin over the event list: repeatedly try removing chunks of
+    events, keeping any reduction that still fails the oracles (the
+    ``failing`` predicate; default = ``run_schedule`` reports >= 1
+    violation). Deterministic injection makes every candidate replayable,
+    so the minimum found is a real reproducer, not a flake. Returns
+    (minimal schedule, oracle runs spent)."""
+    if failing is None:
+        def failing(s: ChaosSchedule) -> bool:
+            return run_schedule(s, simulate_drift=simulate_drift).failing
+
+    runs = 1
+    if not failing(schedule):
+        return schedule, runs  # nothing to shrink
+    events = list(schedule.events)
+    granularity = 2
+    while len(events) >= 2 and runs < max_runs:
+        chunk = max(1, math.ceil(len(events) / granularity))
+        reduced = False
+        for lo in range(0, len(events), chunk):
+            candidate = events[:lo] + events[lo + chunk:]
+            if not candidate:
+                continue
+            runs += 1
+            if failing(schedule.with_events(candidate)):
+                events = candidate
+                granularity = max(granularity - 1, 2)
+                reduced = True
+                break
+            if runs >= max_runs:
+                break
+        if not reduced:
+            if granularity >= len(events):
+                break
+            granularity = min(granularity * 2, len(events))
+    return schedule.with_events(events), runs
+
+
+# -- soak --------------------------------------------------------------------
+
+
+def soak(
+    n: int = 200,
+    seed0: int = 0,
+    simulate_drift: bool = False,
+    verbose: bool = True,
+) -> dict:
+    """Run ``n`` seeded schedules; returns a summary with every failing
+    seed and its shrunk reproducer. The CI entry point
+    (``python -m deequ_tpu.resilience.chaos --soak``)."""
+    import sys
+
+    outcomes: Dict[str, int] = {}
+    failures = []
+    t0 = time.monotonic()
+    for seed in range(seed0, seed0 + n):
+        schedule = ChaosSchedule.generate(seed)
+        report = run_schedule(schedule, simulate_drift=simulate_drift)
+        outcomes[report.outcome] = outcomes.get(report.outcome, 0) + 1
+        if report.failing:
+            shrunk, runs = shrink_schedule(
+                schedule, simulate_drift=simulate_drift
+            )
+            failures.append(
+                {
+                    "seed": seed,
+                    "violations": report.violations,
+                    "shrunk": shrunk.to_dict(),
+                    "shrink_runs": runs,
+                }
+            )
+            if verbose:
+                print(
+                    f"seed {seed}: FAIL {report.violations} "
+                    f"(shrunk to {len(shrunk.events)} events)",
+                    file=sys.stderr,
+                )
+        elif verbose and (seed - seed0) % 20 == 0:
+            print(
+                f"seed {seed}: {report.outcome} "
+                f"({report.elapsed:.2f}s)",
+                file=sys.stderr,
+            )
+    return {
+        "schedules": n,
+        "outcomes": outcomes,
+        "failures": failures,
+        "wall_seconds": round(time.monotonic() - t0, 2),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m deequ_tpu.resilience.chaos",
+        description="deterministic chaos soak over the fault ladder",
+    )
+    parser.add_argument("--soak", action="store_true", help="run N seeds")
+    parser.add_argument("--n", type=int, default=200)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--drift-sim", action="store_true",
+        help="deliberately break bit-identity (oracle self-test: every "
+        "faulted schedule must FAIL and shrink)",
+    )
+    parser.add_argument(
+        "--replay", type=str, default=None,
+        help="replay one schedule fixture (JSON path)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.replay:
+        with open(args.replay) as f:
+            schedule = ChaosSchedule.from_json(f.read())
+        report = run_schedule(schedule)
+        print(
+            json.dumps(
+                {
+                    "outcome": report.outcome,
+                    "violations": report.violations,
+                    "elapsed": round(report.elapsed, 3),
+                    "injected": [list(t) for t in report.injected],
+                }
+            )
+        )
+        return 1 if report.failing else 0
+
+    n = args.n if args.soak else 20
+    summary = soak(n=n, seed0=args.seed, simulate_drift=args.drift_sim)
+    print(json.dumps(summary, indent=2, default=str))
+    if args.drift_sim:
+        # self-test mode: every schedule that injected something must
+        # have been CAUGHT — zero failures means the oracles went blind
+        ok = len(summary["failures"]) > 0
+        print(
+            "drift-sim: oracles "
+            + ("caught the broken ladder" if ok else "MISSED the drift"),
+            file=sys.stderr,
+        )
+        return 0 if ok else 1
+    return 1 if summary["failures"] else 0
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    code = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # skip interpreter teardown: abandoned watchdog threads (hung-call
+    # detection leaves them parked by design) can segfault inside XLA's
+    # destructors at exit, turning a clean soak into a bogus nonzero
+    os._exit(code)

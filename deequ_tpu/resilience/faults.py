@@ -326,6 +326,18 @@ class FaultInjectingScanHook:
     ``(kind, scan_id, attempt, device)`` for targeted faults — to
     ``injected`` and every observation to ``calls`` — determinism is
     asserted by comparing these logs across replays.
+
+    ``hang_release`` decides what a released hang does: ``"ok"``
+    (default) returns and lets the stalled dispatch proceed — the shape
+    the watchdog tests pin (no deadline armed => the scan just takes
+    that long); ``"error"`` raises an UNAVAILABLE InjectedDeviceError
+    after the sleep, modeling a hung call that eventually surfaces a
+    device loss. The chaos engine uses ``"error"``: after the
+    attempt-level watchdog ABANDONS a hung attempt, an "ok" release
+    would let the zombie worker dispatch its stale program against the
+    resharded mesh — on the CPU test backend, whose collectives share
+    one device-thread pool, that interleaving deadlocks the rendezvous
+    (a real accelerator runs disjoint device sets independently).
     """
 
     def __init__(
@@ -334,6 +346,7 @@ class FaultInjectingScanHook:
         hang_seconds: float = 30.0,
         spare_fallback: bool = True,
         relative: bool = True,
+        hang_release: str = "ok",
     ):
         self.faults: Dict[int, Tuple[str, float, Optional[int]]] = {}
         for scan, spec in (faults or {}).items():
@@ -352,6 +365,11 @@ class FaultInjectingScanHook:
         self.hang_seconds = float(hang_seconds)
         self.spare_fallback = bool(spare_fallback)
         self.relative = bool(relative)
+        if hang_release not in ("ok", "error"):
+            raise ValueError(
+                f"hang_release must be 'ok' or 'error', got {hang_release!r}"
+            )
+        self.hang_release = hang_release
         self._base_scan_id: Optional[int] = None
         self.injected: List[Tuple] = []
         self.calls: List[Tuple[str, int, int, int]] = []
@@ -383,6 +401,13 @@ class FaultInjectingScanHook:
             self.injected.append((kind, scan_id, attempt, device))
             if kind == "hang":
                 time.sleep(self.hang_seconds)
+                if self.hang_release == "error":
+                    raise InjectedDeviceError(
+                        _TARGETED_FAULT_MESSAGES["lost"].format(
+                            nbytes=8 << 30, scan_id=scan_id,
+                            attempt=attempt, device=device,
+                        )
+                    )
                 return
             raise InjectedDeviceError(
                 _TARGETED_FAULT_MESSAGES[kind].format(
@@ -393,6 +418,12 @@ class FaultInjectingScanHook:
         self.injected.append((kind, scan_id, attempt))
         if kind == "hang":
             time.sleep(self.hang_seconds)
+            if self.hang_release == "error":
+                raise InjectedDeviceError(
+                    _DEVICE_FAULT_MESSAGES["lost"].format(
+                        nbytes=8 << 30, scan_id=scan_id, attempt=attempt
+                    )
+                )
             return
         raise InjectedDeviceError(
             _DEVICE_FAULT_MESSAGES[kind].format(

@@ -158,7 +158,15 @@ class RetryPolicy:
     def call(self, fn: Callable, *args, what: str = "operation", **kwargs):
         """Run ``fn`` under the policy; raises RetryExhaustedException when
         the attempt budget or deadline runs out. Every invocation feeds
-        the process-wide RETRY_TELEMETRY counters."""
+        the process-wide RETRY_TELEMETRY counters, and every FAILED try
+        charges the ambient run budget (resilience/governance.py) — a
+        run-level ``max_total_attempts`` bounds the composed ladder, so
+        an exhausted budget raises typed from here mid-retry."""
+        from deequ_tpu.resilience.governance import (
+            charge_run_budget,
+            run_budget_remaining,
+        )
+
         start = time.monotonic()
         attempt = 0
         RETRY_TELEMETRY.invocations += 1
@@ -169,6 +177,7 @@ class RetryPolicy:
                 if not self.is_retryable(e):
                     raise
                 RETRY_TELEMETRY.record_attempt()
+                charge_run_budget("io_retry", what=what)
                 attempt += 1
                 out_of_time = (
                     self.deadline is not None
@@ -178,6 +187,12 @@ class RetryPolicy:
                     RETRY_TELEMETRY.record_exhausted(e)
                     raise RetryExhaustedException(what, attempt, e) from e
                 delay = self.delay_for(attempt - 1)
+                # never sleep past the run's wall budget: the next charge
+                # would exhaust it anyway, but the sleep itself must not
+                # overshoot the deadline the caller promised
+                wall_left = run_budget_remaining()
+                if wall_left is not None:
+                    delay = min(delay, wall_left)
                 RETRY_TELEMETRY.record_retry(delay, e)
                 time.sleep(delay)
 
@@ -312,6 +327,11 @@ def resilient_batches(
         raise ValueError(
             f"on_batch_error must be 'fail' or 'skip', got {on_batch_error!r}"
         )
+    from deequ_tpu.resilience.governance import (
+        charge_run_budget,
+        run_budget_remaining,
+    )
+
     cur = start
     attempts = 0
     consecutive_skips = 0
@@ -352,8 +372,12 @@ def resilient_batches(
                 raise
             attempts += 1
             # telemetry: a FAILED read is an attempt (same meaning as
-            # RetryPolicy.call — the clean fast path never counts)
+            # RetryPolicy.call — the clean fast path never counts), and
+            # every failed read charges the ambient run budget too: a
+            # stream of N batches retries against ONE global
+            # max_total_attempts, not N per-batch budgets
             RETRY_TELEMETRY.record_attempt()
+            charge_run_budget("io_retry", batch=cur)
             # non-retryable-but-skippable errors quarantine IMMEDIATELY:
             # the policy's retry_on filter said backoff cannot help here
             out_of_budget = (
@@ -389,6 +413,11 @@ def resilient_batches(
                     f"batch {cur} read", attempts, e
                 ) from e
             delay = policy.delay_for(attempts - 1)
+            # cap the backoff at the run's remaining wall budget (same
+            # rationale as RetryPolicy.call)
+            wall_left = run_budget_remaining()
+            if wall_left is not None:
+                delay = min(delay, wall_left)
             RETRY_TELEMETRY.record_retry(delay, e)
             time.sleep(delay)
 

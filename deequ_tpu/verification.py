@@ -72,7 +72,18 @@ class VerificationResult:
       lint is armed (``DEEQU_TPU_PLAN_LINT=warn|error``): each row is
       ``{rule, severity, message, location}``. Empty on a healthy run —
       ``"error"`` mode raises typed ``PlanLintError`` pre-dispatch
-      instead of completing with error findings."""
+      instead of completing with error findings.
+
+    Run-level governance (resilience/governance.py) reports here too:
+
+    - ``run_budget`` — the armed RunBudget's ledger snapshot (attempts
+      charged per ladder rung, elapsed wall, the exhaustion reason if
+      any); empty when the run was ungoverned. A budget-exhausted run
+      under ``on_budget_exhausted="degrade"`` completes as a PARTIAL
+      result: the analyzers whose scans could not finish carry typed
+      ``RunBudgetExhaustedException`` failure metrics and the rows never
+      verified land on ``unverified_row_ranges`` (kind
+      ``budget_exhausted`` in ``device_events``)."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
@@ -86,6 +97,7 @@ class VerificationResult:
     resharded: bool = False
     unverified_row_ranges: List[tuple] = field(default_factory=list)
     plan_lints: List[dict] = field(default_factory=list)
+    run_budget: Dict[str, object] = field(default_factory=dict)
 
     @staticmethod
     def success_metrics_as_rows(
@@ -198,6 +210,9 @@ class VerificationSuite:
         shard_deadline: Optional[float] = None,
         on_peer_loss: Optional[str] = None,
         peer_timeout: Optional[float] = None,
+        run_deadline: Optional[float] = None,
+        max_total_attempts: Optional[int] = None,
+        on_budget_exhausted: Optional[str] = None,
     ) -> VerificationResult:
         """Resilience knobs (streaming tables; deequ_tpu/resilience):
         ``checkpoint`` (StreamCheckpointer or directory path) makes the
@@ -227,8 +242,26 @@ class VerificationSuite:
         peer process stopped responding; ``"degrade"`` completes on the
         surviving hosts and reports the lost hosts' row ranges on
         ``result.unverified_row_ranges`` / ``result.mesh_events``.
-        ``peer_timeout`` overrides the heartbeat/barrier timeout."""
+        ``peer_timeout`` overrides the heartbeat/barrier timeout.
+
+        Run-governance knobs (resilience/governance.py):
+        ``run_deadline`` (wall seconds) / ``max_total_attempts`` arm ONE
+        fault budget for the whole run — every rung of the composed
+        resilience ladder (I/O retries, OOM bisections, encoded
+        demotions, mesh reshards, CPU fallbacks, across every per-batch
+        scan of a streaming run) charges it. On exhaustion,
+        ``on_budget_exhausted="degrade"`` (default) completes with a
+        PARTIAL result — failure metrics for the analyzers whose scans
+        could not finish, exact ``unverified_row_ranges`` for the rows
+        never verified — while ``"raise"`` propagates a typed
+        ``RunBudgetExhaustedException``. The ledger lands on
+        ``result.run_budget``."""
         from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.resilience.governance import (
+            current_run_budget,
+            resolve_run_policy,
+            run_budget_scope,
+        )
         from deequ_tpu.resilience.retry import RETRY_TELEMETRY
 
         analyzers = list(required_analyzers)
@@ -248,51 +281,73 @@ class VerificationSuite:
                 "device_fetches",
                 "bytes_fetched",
                 "drain_wait_seconds",
+                "budget_charges",
+                "budget_exhaustions",
             )
         }
 
-        # the peer check runs INSIDE the run (after the telemetry baseline
-        # capture) so a degraded outcome lands on THIS result's
-        # unverified_row_ranges/mesh_events delta
-        if on_peer_loss is not None:
-            from deequ_tpu.parallel.distributed import (
-                DEFAULT_PEER_TIMEOUT,
-                check_peers,
+        # run-level governance: arm ONE fault budget for the whole run
+        # (unless the caller already installed an ambient one) and make
+        # it the scope every charge site inside resolves — I/O retries,
+        # ladder rungs, and every per-batch scan of a streaming run all
+        # draw on this single ledger
+        budget = current_run_budget()
+        armed_here = None
+        if budget is None:
+            run_policy = resolve_run_policy(
+                run_deadline, max_total_attempts, on_budget_exhausted
             )
+            if run_policy is not None:
+                budget = armed_here = run_policy.arm()
 
-            # a count-less streaming source (StreamingTable.num_rows
-            # RAISES when the source doesn't know) still gets the peer
-            # check — the lost hosts just can't be mapped to row ranges
-            try:
-                total_rows = int(data.num_rows or 0)
-            except (AttributeError, TypeError):
-                total_rows = 0
-            check_peers(
-                total_rows,
-                timeout=(
-                    DEFAULT_PEER_TIMEOUT
-                    if peer_timeout is None
-                    else peer_timeout
-                ),
-                on_peer_loss=on_peer_loss,
+        from contextlib import nullcontext
+
+        with (
+            run_budget_scope(budget) if armed_here is not None
+            else nullcontext()
+        ):
+            # the peer check runs INSIDE the run (after the telemetry
+            # baseline capture) so a degraded outcome lands on THIS
+            # result's unverified_row_ranges/mesh_events delta
+            if on_peer_loss is not None:
+                from deequ_tpu.parallel.distributed import (
+                    DEFAULT_PEER_TIMEOUT,
+                    check_peers,
+                )
+
+                # a count-less streaming source (StreamingTable.num_rows
+                # RAISES when the source doesn't know) still gets the peer
+                # check — the lost hosts just can't be mapped to row ranges
+                try:
+                    total_rows = int(data.num_rows or 0)
+                except (AttributeError, TypeError):
+                    total_rows = 0
+                check_peers(
+                    total_rows,
+                    timeout=(
+                        DEFAULT_PEER_TIMEOUT
+                        if peer_timeout is None
+                        else peer_timeout
+                    ),
+                    on_peer_loss=on_peer_loss,
+                )
+
+            analysis_context = AnalysisRunner.do_analysis_run(
+                data,
+                unique_analyzers,
+                aggregate_with=aggregate_with,
+                save_states_with=save_states_with,
+                metrics_repository=metrics_repository,
+                reuse_existing_results_for_key=reuse_existing_results_for_key,
+                fail_if_results_missing=fail_if_results_missing,
+                group_memory_budget=group_memory_budget,
+                checkpoint=checkpoint,
+                on_batch_error=on_batch_error,
+                retry_policy=retry_policy,
+                on_device_error=on_device_error,
+                device_deadline=device_deadline,
+                shard_deadline=shard_deadline,
             )
-
-        analysis_context = AnalysisRunner.do_analysis_run(
-            data,
-            unique_analyzers,
-            aggregate_with=aggregate_with,
-            save_states_with=save_states_with,
-            metrics_repository=metrics_repository,
-            reuse_existing_results_for_key=reuse_existing_results_for_key,
-            fail_if_results_missing=fail_if_results_missing,
-            group_memory_budget=group_memory_budget,
-            checkpoint=checkpoint,
-            on_batch_error=on_batch_error,
-            retry_policy=retry_policy,
-            on_device_error=on_device_error,
-            device_deadline=device_deadline,
-            shard_deadline=shard_deadline,
-        )
 
         # evaluate BEFORE appending the new result: anomaly constraints query
         # the repository history, which must not yet contain this run
@@ -323,6 +378,8 @@ class VerificationSuite:
         ]
         if SCAN_STATS.fallback_scans > fallback_before:
             result.fallback_backend = SCAN_STATS.fallback_backend
+        if budget is not None:
+            result.run_budget = budget.snapshot()
         result.retry_stats = RETRY_TELEMETRY.delta_since(retry_before)
         result.scan_stats = {
             k: round(getattr(SCAN_STATS, k) - v, 6)
@@ -534,6 +591,9 @@ class VerificationRunBuilder:
         self._shard_deadline: Optional[float] = None
         self._on_peer_loss: Optional[str] = None
         self._peer_timeout: Optional[float] = None
+        self._run_deadline: Optional[float] = None
+        self._max_total_attempts: Optional[int] = None
+        self._on_budget_exhausted: Optional[str] = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -665,6 +725,46 @@ class VerificationRunBuilder:
             self._peer_timeout = float(timeout)
         return self
 
+    def with_run_budget(
+        self,
+        run_deadline: Optional[float] = None,
+        max_total_attempts: Optional[int] = None,
+        on_budget_exhausted: str = "degrade",
+    ) -> "VerificationRunBuilder":
+        """Arm ONE run-level fault budget for this run
+        (resilience/governance.py): ``run_deadline`` bounds the run's
+        wall clock, ``max_total_attempts`` bounds the failure-driven
+        attempts of the COMPOSED resilience ladder — I/O retries, OOM
+        bisections, encoded demotions, mesh reshards, and CPU fallbacks
+        all charge this single ledger (a streaming run's per-batch scans
+        included), where previously each rung only bounded itself. On
+        exhaustion ``"degrade"`` (default) completes with a partial
+        result — failure metrics plus exact
+        ``result.unverified_row_ranges`` — and ``"raise"`` propagates a
+        typed ``RunBudgetExhaustedException``. Also settable
+        process-wide via ``DEEQU_TPU_RUN_DEADLINE`` /
+        ``DEEQU_TPU_RUN_ATTEMPTS``. The spent ledger is reported on
+        ``result.run_budget``."""
+        if run_deadline is None and max_total_attempts is None:
+            raise ValueError(
+                "with_run_budget needs run_deadline and/or "
+                "max_total_attempts"
+            )
+        if on_budget_exhausted not in ("degrade", "raise"):
+            raise ValueError(
+                f"on_budget_exhausted must be 'degrade' or 'raise', "
+                f"got {on_budget_exhausted!r}"
+            )
+        self._run_deadline = (
+            float(run_deadline) if run_deadline is not None else None
+        )
+        self._max_total_attempts = (
+            int(max_total_attempts) if max_total_attempts is not None
+            else None
+        )
+        self._on_budget_exhausted = on_budget_exhausted
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -705,6 +805,9 @@ class VerificationRunBuilder:
             shard_deadline=self._shard_deadline,
             on_peer_loss=self._on_peer_loss,
             peer_timeout=self._peer_timeout,
+            run_deadline=self._run_deadline,
+            max_total_attempts=self._max_total_attempts,
+            on_budget_exhausted=self._on_budget_exhausted,
         )
 
 

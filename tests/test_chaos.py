@@ -1,0 +1,477 @@
+"""Run-level fault governance + deterministic chaos engine
+(resilience/governance.py + resilience/chaos.py).
+
+The acceptance pair: (1) a single RunBudget spans the COMPOSED ladder —
+I/O retries, OOM bisections, mesh reshards, and CPU fallbacks across
+every scan of a run charge one ledger, and exhaustion mid-rung degrades
+to a partial result with exact ``unverified_row_ranges`` instead of
+raising or hanging; (2) every tier-1 chaos schedule (the shrunk-fixture
+corpus) terminates within its deadline with a typed outcome and passes
+all invariant oracles, and a deliberately broken ladder (drift sim) is
+caught by an oracle and shrunk to a minimal reproducer.
+"""
+
+import glob
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import (
+    Completeness,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.data.streaming import stream_table
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.exceptions import RunBudgetExhaustedException
+from deequ_tpu.ops.device_policy import DEVICE_HEALTH, MESH_HEALTH
+from deequ_tpu.ops.scan_engine import (
+    SCAN_STATS,
+    install_scan_fault_hook,
+)
+from deequ_tpu.resilience import (
+    RETRY_TELEMETRY,
+    FaultInjectingScanHook,
+    FaultSchedule,
+    FlakyBatchSource,
+    RetryPolicy,
+    RunPolicy,
+    current_run_budget,
+    fault_state_scope,
+    run_budget_scope,
+)
+from deequ_tpu.resilience.chaos import (
+    ChaosSchedule,
+    run_schedule,
+    shrink_schedule,
+    soak,
+)
+from deequ_tpu.verification import VerificationSuite
+
+pytestmark = pytest.mark.chaos
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "chaos")
+FAST = RetryPolicy(max_attempts=4, base_delay=0.0005, max_delay=0.002)
+
+
+def int_table(n=2000, seed=3):
+    """Integer-valued columns: every fold sum is exact in f64, so
+    recovered runs are bit-identical to clean ones."""
+    rng = np.random.default_rng(seed)
+    vals = rng.integers(0, 1000, n).astype(np.float64)
+    mask = np.ones(n, dtype=np.bool_)
+    mask[::97] = False
+    return ColumnarTable(
+        [
+            Column(
+                "id", DType.INTEGRAL,
+                values=np.arange(n, dtype=np.int64),
+                mask=np.ones(n, dtype=np.bool_),
+            ),
+            Column("val", DType.FRACTIONAL, values=vals, mask=mask),
+        ]
+    )
+
+
+def analyzers_for():
+    return [Size(), Completeness("val"), Mean("val"), Minimum("val"),
+            Maximum("val")]
+
+
+def check_for():
+    return Check(CheckLevel.ERROR, "chaos").has_size(lambda s: s >= 0)
+
+
+# -- RunPolicy / RunBudget unit behavior -------------------------------------
+
+
+def test_run_policy_validation():
+    with pytest.raises(ValueError):
+        RunPolicy(on_budget_exhausted="explode")
+    with pytest.raises(ValueError):
+        RunPolicy(run_deadline=-1.0)
+    with pytest.raises(ValueError):
+        VerificationSuite.on_data(int_table(8)).with_run_budget()
+
+
+def test_budget_ledger_accounting_and_typed_exhaustion():
+    budget = RunPolicy(max_total_attempts=2).arm()
+    budget.charge("io_retry")
+    budget.charge("oom_bisect")
+    assert budget.attempts == 2
+    assert budget.charges == {"io_retry": 1, "oom_bisect": 1}
+    assert budget.exhausted_reason is None
+    with pytest.raises(RunBudgetExhaustedException) as ei:
+        budget.charge("mesh_reshard")
+    assert ei.value.reason == "max_total_attempts"
+    assert ei.value.degraded  # default policy mode
+    assert ei.value.ledger["charges"] == {
+        "io_retry": 1, "oom_bisect": 1, "mesh_reshard": 1,
+    }
+    # once exhausted, EVERY further charge re-raises: a nested retry
+    # loop that swallowed the first raise cannot keep spending
+    with pytest.raises(RunBudgetExhaustedException):
+        budget.charge("io_retry")
+    assert budget.attempts == sum(budget.charges.values())
+
+
+def test_budget_wall_deadline_exhausts():
+    budget = RunPolicy(run_deadline=0.02, on_budget_exhausted="raise").arm()
+    time.sleep(0.03)
+    with pytest.raises(RunBudgetExhaustedException) as ei:
+        budget.charge("io_retry")
+    assert ei.value.reason == "run_deadline"
+    assert not ei.value.degraded
+
+
+def test_budget_scope_is_ambient_and_restores():
+    assert current_run_budget() is None
+    budget = RunPolicy(max_total_attempts=5).arm()
+    with run_budget_scope(budget):
+        assert current_run_budget() is budget
+    assert current_run_budget() is None
+
+
+def test_fault_state_scope_isolates_singletons_and_hook():
+    DEVICE_HEALTH.reset()
+    MESH_HEALTH.reset()
+    outer_attempts = RETRY_TELEMETRY.attempts
+
+    def hook(boundary, ctx):
+        pass
+
+    prev = install_scan_fault_hook(hook)
+    try:
+        with fault_state_scope():
+            # the scope starts clean (hook removed, counters reset) ...
+            from deequ_tpu.ops.device_policy import current_scan_fault_hook
+
+            assert current_scan_fault_hook() is None
+            DEVICE_HEALTH.consecutive_faults = 99
+            MESH_HEALTH.consecutive_faults[3] = 7
+            RETRY_TELEMETRY.attempts += 41
+        # ... and leaks NOTHING out
+        assert DEVICE_HEALTH.consecutive_faults == 0
+        assert MESH_HEALTH.consecutive_faults == {}
+        assert RETRY_TELEMETRY.attempts == outer_attempts
+        from deequ_tpu.ops.device_policy import current_scan_fault_hook
+
+        assert current_scan_fault_hook() is hook
+    finally:
+        install_scan_fault_hook(prev)
+
+
+# -- one budget across the composed ladder -----------------------------------
+
+
+def test_bisect_and_reshard_charge_one_budget():
+    """Two scans, two different rungs (OOM bisection, then a targeted
+    chip loss resharding the mesh) — one ledger records both."""
+    from deequ_tpu.parallel.mesh import current_mesh, mesh_device_ids
+
+    mesh = current_mesh()
+    if mesh is None or math.prod(mesh.devices.shape) < 2:
+        pytest.skip("needs the virtual 8-device mesh")
+    victim = mesh_device_ids(mesh)[1]
+    with fault_state_scope():
+        hook = FaultInjectingScanHook(
+            {0: ("oom", 2), 1: ("lost", 1, victim)}, relative=True
+        )
+        install_scan_fault_hook(hook)
+        budget = RunPolicy(max_total_attempts=10).arm()
+        with run_budget_scope(budget):
+            ctx1 = AnalysisRunner.do_analysis_run(
+                int_table(seed=1), analyzers_for()
+            )
+            ctx2 = AnalysisRunner.do_analysis_run(
+                int_table(seed=2), analyzers_for()
+            )
+        assert all(m.value.is_success for m in ctx1.all_metrics())
+        assert all(m.value.is_success for m in ctx2.all_metrics())
+        assert budget.charges.get("oom_bisect") == 2
+        assert budget.charges.get("mesh_reshard") == 1
+        assert budget.attempts == sum(budget.charges.values())
+        assert budget.exhausted_reason is None
+
+
+def test_io_retries_and_ladder_share_the_budget():
+    """A streaming run where batch reads retry AND a scan OOMs: io_retry
+    and oom_bisect charges land on the same ledger (the per-batch scans
+    of a stream never get their own)."""
+    table = int_table()
+    with fault_state_scope():
+        hook = FaultInjectingScanHook({1: ("oom", 1)}, relative=True)
+        install_scan_fault_hook(hook)
+        schedule = FaultSchedule(fail={("batch", 0): 2})
+        from deequ_tpu.data.source import TableBatchSource
+        from deequ_tpu.data.streaming import StreamingTable
+
+        stream = StreamingTable(
+            FlakyBatchSource(TableBatchSource(table, 500), schedule)
+        )
+        result = VerificationSuite.do_verification_run(
+            stream, [check_for()], analyzers_for(),
+            on_batch_error="skip", retry_policy=FAST,
+            max_total_attempts=10,
+        )
+    assert result.status.name != "ERROR"
+    assert result.run_budget["charges"]["io_retry"] == 2
+    assert result.run_budget["charges"]["oom_bisect"] == 1
+    assert result.run_budget["attempts"] == 3
+    assert result.run_budget["exhausted"] is None
+    # the scan_stats delta mirrors the ledger (the ScanStats.budget_*
+    # observables)
+    assert result.scan_stats["budget_charges"] == 3
+    assert result.scan_stats["budget_exhaustions"] == 0
+    # and the retry telemetry agrees with the io_retry charges
+    assert result.retry_stats["attempts"] == 2
+
+
+# -- degradation to partial results ------------------------------------------
+
+
+def test_budget_exhaustion_mid_bisection_degrades_partial():
+    table = int_table()
+    with fault_state_scope():
+        hook = FaultInjectingScanHook(
+            {0: ("oom", FaultSchedule.PERMANENT)}, relative=True
+        )
+        install_scan_fault_hook(hook)
+        result = VerificationSuite.do_verification_run(
+            table, [check_for()], analyzers_for(),
+            max_total_attempts=2, on_budget_exhausted="degrade",
+        )
+    # the run COMPLETED (no raise), reports the exact unverified range,
+    # and every analyzer carries the typed exhaustion failure
+    assert result.run_budget["exhausted"] == "max_total_attempts"
+    assert result.unverified_row_ranges == [(0, table.num_rows)]
+    kinds = [e["kind"] for e in result.device_events]
+    assert "budget_exhausted" in kinds and "oom_bisect" in kinds
+    for metric in result.metrics.values():
+        assert metric.value.is_failure
+        assert isinstance(
+            metric.value.exception, RunBudgetExhaustedException
+        )
+    assert result.scan_stats["budget_exhaustions"] == 1
+
+
+def test_budget_exhaustion_mid_reshard_degrades_partial():
+    from deequ_tpu.parallel.mesh import current_mesh, mesh_device_ids
+
+    mesh = current_mesh()
+    if mesh is None or math.prod(mesh.devices.shape) < 2:
+        pytest.skip("needs the virtual 8-device mesh")
+    victim = mesh_device_ids(mesh)[2]
+    table = int_table()
+    with fault_state_scope():
+        hook = FaultInjectingScanHook(
+            {0: ("lost", FaultSchedule.PERMANENT, victim)}, relative=True
+        )
+        install_scan_fault_hook(hook)
+        # a zero budget: the FIRST reshard charge exhausts it mid-rung
+        result = VerificationSuite.do_verification_run(
+            table, [check_for()], analyzers_for(),
+            max_total_attempts=0, on_budget_exhausted="degrade",
+        )
+    assert result.run_budget["exhausted"] == "max_total_attempts"
+    assert result.run_budget["charges"] == {"mesh_reshard": 1}
+    assert result.unverified_row_ranges == [(0, table.num_rows)]
+    for metric in result.metrics.values():
+        assert isinstance(
+            metric.value.exception, RunBudgetExhaustedException
+        )
+
+
+def test_streaming_budget_exhaustion_yields_exact_partial():
+    """Mid-stream exhaustion: batches folded before the budget ran out
+    finalize into REAL metrics; the tail is reported unverified with an
+    exact batch-aligned range."""
+    table = int_table()
+    with fault_state_scope():
+        hook = FaultInjectingScanHook(
+            {2: ("oom", FaultSchedule.PERMANENT)}, relative=True
+        )
+        install_scan_fault_hook(hook)
+        result = VerificationSuite.do_verification_run(
+            stream_table(table, 500), [check_for()], analyzers_for(),
+            on_batch_error="skip", retry_policy=FAST,
+            max_total_attempts=2, on_budget_exhausted="degrade",
+        )
+    assert result.run_budget["exhausted"] == "max_total_attempts"
+    assert result.unverified_row_ranges == [(1000, 2000)]
+    by_name = {str(a): m for a, m in result.metrics.items()}
+    size = by_name["Size(where=None)"]
+    assert size.value.is_success and size.value.get() == 1000.0
+    # partial metrics cover EXACTLY the verified prefix
+    expected_mean = float(
+        np.mean(table["val"].values[:1000][table["val"].mask[:1000]])
+    )
+    mean = by_name["Mean(column='val', where=None)"]
+    assert mean.value.is_success and mean.value.get() == expected_mean
+
+
+def test_stream_cannot_exceed_attempts_by_paying_per_batch():
+    """The satellite fix pinned: per-batch retries across a stream share
+    ONE max_total_attempts — two flaky batches needing 2 retries each
+    exhaust a 3-attempt budget, where per-batch budgets would have let
+    each spend its own."""
+    table = int_table()
+    with fault_state_scope():
+        schedule = FaultSchedule(
+            fail={("batch", 0): 2, ("batch", 2): 2}
+        )
+        from deequ_tpu.data.source import TableBatchSource
+        from deequ_tpu.data.streaming import StreamingTable
+
+        stream = StreamingTable(
+            FlakyBatchSource(TableBatchSource(table, 500), schedule)
+        )
+        result = VerificationSuite.do_verification_run(
+            stream, [check_for()], analyzers_for(),
+            on_batch_error="skip", retry_policy=FAST,
+            max_total_attempts=3, on_budget_exhausted="degrade",
+        )
+    assert result.run_budget["exhausted"] == "max_total_attempts"
+    assert result.run_budget["charges"] == {"io_retry": 4}
+    # batches 0 and 1 were verified before the budget died on batch 2
+    assert result.unverified_row_ranges == [(1000, 2000)]
+
+
+def test_raise_mode_propagates_typed():
+    table = int_table()
+    with fault_state_scope():
+        hook = FaultInjectingScanHook(
+            {0: ("oom", FaultSchedule.PERMANENT)}, relative=True
+        )
+        install_scan_fault_hook(hook)
+        with pytest.raises(RunBudgetExhaustedException) as ei:
+            VerificationSuite.do_verification_run(
+                table, [check_for()], analyzers_for(),
+                max_total_attempts=1, on_budget_exhausted="raise",
+            )
+        assert not ei.value.degraded
+        assert ei.value.ledger["charges"] == {"oom_bisect": 2}
+
+
+def test_run_deadline_caps_watchdog_so_hangs_terminate():
+    """A hung device call with NO explicit device_deadline still
+    terminates inside run_deadline: the budget arms the watchdog with
+    its remaining wall."""
+    table = int_table(500)
+    with fault_state_scope():
+        hook = FaultInjectingScanHook(
+            {0: ("hang", 1)}, hang_seconds=30.0, relative=True
+        )
+        install_scan_fault_hook(hook)
+        t0 = time.monotonic()
+        result = VerificationSuite.do_verification_run(
+            table, [check_for()], analyzers_for(),
+            on_device_error="fallback",
+            run_deadline=1.0, on_budget_exhausted="degrade",
+        )
+        elapsed = time.monotonic() - t0
+    # the hang converted typed within ~run_deadline; the wall budget it
+    # consumed leaves no room for the fallback rung, so the run degrades
+    # to a typed partial instead of completing late — termination within
+    # run_deadline wins over completion, by design
+    assert elapsed < 8.0
+    assert SCAN_STATS.watchdog_timeouts >= 1
+    assert result.run_budget["exhausted"] == "run_deadline"
+    assert result.unverified_row_ranges == [(0, table.num_rows)]
+    for metric in result.metrics.values():
+        assert isinstance(
+            metric.value.exception, RunBudgetExhaustedException
+        )
+
+
+def test_healthy_run_charges_nothing():
+    result = VerificationSuite.do_verification_run(
+        int_table(), [check_for()], analyzers_for(),
+        run_deadline=30.0, max_total_attempts=5,
+    )
+    assert result.status.name == "SUCCESS"
+    assert result.run_budget["attempts"] == 0
+    assert result.run_budget["charges"] == {}
+    assert result.scan_stats["budget_charges"] == 0
+
+
+# -- chaos schedules ----------------------------------------------------------
+
+
+def test_schedule_json_roundtrip_including_permanent():
+    schedule = ChaosSchedule(
+        seed=7,
+        events=(
+            {"seam": "scan", "scan": 1, "kind": "lost",
+             "times": FaultSchedule.PERMANENT, "device": 3},
+            {"seam": "batch", "index": 0, "times": 2.0},
+        ),
+        run_deadline=9.0,
+        max_total_attempts=4,
+        on_budget_exhausted="raise",
+    )
+    back = ChaosSchedule.from_json(schedule.to_json())
+    assert back == schedule
+    assert math.isinf(back.events[0]["times"])
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.json"))),
+    ids=lambda p: os.path.basename(p).replace(".json", ""),
+)
+def test_fixture_corpus_replays_bit_identically(fixture):
+    """Every schedule the shrinker produced during development: two
+    replays agree bit-for-bit (outcome, injected fault log, metrics) and
+    pass every invariant oracle within the deadline."""
+    with open(fixture) as f:
+        schedule = ChaosSchedule.from_json(f.read())
+    first = run_schedule(schedule)
+    second = run_schedule(schedule)
+    assert first.violations == [] and second.violations == []
+    assert first.outcome == second.outcome
+    assert first.injected == second.injected
+    assert first.metrics == second.metrics
+    assert first.skipped == second.skipped
+    assert first.unverified == second.unverified
+
+
+def test_generated_schedules_pass_oracles_quick():
+    """A small always-on slice of the soak: every outcome is typed, every
+    oracle holds (the 200-schedule version is the slow-marked soak)."""
+    for seed in (0, 4, 5, 12):
+        report = run_schedule(ChaosSchedule.generate(seed))
+        assert report.violations == [], (seed, report.violations)
+
+
+def test_drift_sim_is_caught_and_shrinks_to_minimal_repro():
+    """The deliberately broken ladder: with simulate_drift the recovery
+    loses bit-identity, an oracle catches it, and ddmin reduces the
+    schedule to a <=3-event reproducer that still fails."""
+    schedule = ChaosSchedule.generate(5)  # multi-event, injects faults
+    assert len(schedule.events) >= 2
+    report = run_schedule(schedule, simulate_drift=True)
+    assert report.failing
+    assert any("reference" in v for v in report.violations)
+    shrunk, runs = shrink_schedule(schedule, simulate_drift=True)
+    assert len(shrunk.events) <= 3
+    assert run_schedule(shrunk, simulate_drift=True).failing
+    # and WITHOUT the simulated bug the reproducer is clean — the
+    # failure was the drift, not the schedule
+    assert not run_schedule(shrunk).failing
+
+
+@pytest.mark.slow
+def test_chaos_soak_200_schedules():
+    """CI soak (slow tier): 200 seeded schedules, zero oracle
+    violations. Runnable standalone as
+    ``python -m deequ_tpu.resilience.chaos --soak``."""
+    summary = soak(n=200, seed0=0, verbose=False)
+    assert summary["failures"] == []

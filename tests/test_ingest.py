@@ -246,6 +246,78 @@ def test_parquet_near_unique_column_stays_plain(tmp_path):
     assert np.array_equal(batches[0]["u"].values[:10], t["u"].values[:10])
 
 
+def _write_csv(path, n=4000, card=13):
+    """Low-cardinality int + float columns, a near-unique float, a
+    string column, and empty-cell nulls every 53rd row."""
+    with open(path, "w") as f:
+        f.write("i,f,u,s\n")
+        for k in range(n):
+            i = "" if k % 53 == 0 else str(k % card)
+            f.write(f"{i},{(k % card) / 2},{k * 1.5},s{k % 5}\n")
+
+
+def test_csv_source_sniffs_and_carries_encoding(tmp_path):
+    """PR-8 follow-up: CSV has no encoding metadata, so the source
+    sniffs cardinality on the FIRST block and opts qualifying numeric
+    columns into the encoded plane, mirroring the Parquet path."""
+    from deequ_tpu.data.io import read_csv
+    from deequ_tpu.data.source import CSVBatchSource
+
+    path = str(tmp_path / "enc.csv")
+    _write_csv(path)
+    src = CSVBatchSource(path)
+    assert src.encoded_column_names == frozenset({"i", "f"})
+    batches = list(src.batches(batch_rows=1024))
+    assert all(b["i"].encoding is not None for b in batches)
+    assert all(b["f"].encoding is not None for b in batches)
+    assert all(b["u"].encoding is None for b in batches)
+    merged = batches[0]
+    for b in batches[1:]:
+        merged = merged.concat(b)
+    ref = read_csv(path)
+    assert merged.num_rows == ref.num_rows
+    for name in ("i", "f", "u"):
+        assert np.array_equal(merged[name].values, ref[name].values)
+        assert np.array_equal(merged[name].mask, ref[name].mask)
+
+
+def test_csv_density_rule_keeps_near_unique_plain(tmp_path):
+    """The density rule mirrored from Parquet: a numeric column whose
+    first-block cardinality exceeds 1 distinct per 4 rows stays plain,
+    and encoded batch SIZING engages for the qualifying columns."""
+    from deequ_tpu.data.source import CSVBatchSource
+
+    path = str(tmp_path / "uniq.csv")
+    _write_csv(path)
+    src = CSVBatchSource(path)
+    assert "u" not in src.encoded_column_names  # ~unique: fails density
+    assert "s" not in src.encoded_column_names  # strings have their own plane
+    # empty file (header only): nothing qualifies, nothing crashes
+    empty = str(tmp_path / "empty.csv")
+    with open(empty, "w") as f:
+        f.write("a,b\n")
+    assert CSVBatchSource(empty).encoded_column_names == frozenset()
+
+
+def test_csv_encoded_stream_metrics_match_decoded(tmp_path):
+    """Encoded CSV ingest is bit-identical to the in-memory decoded run
+    for the scan-shareable families (the ingest contract, now over the
+    CSV source)."""
+    from deequ_tpu.data.io import read_csv, stream_csv
+    from deequ_tpu.verification import VerificationSuite
+
+    path = str(tmp_path / "m.csv")
+    _write_csv(path)
+    analyzers = [
+        Size(), Completeness("i"), Mean("f"), Minimum("f"), Maximum("f"),
+        Sum("i"),
+    ]
+    ref = AnalysisRunner.do_analysis_run(read_csv(path), analyzers)
+    got = AnalysisRunner.do_analysis_run(stream_csv(path, batch_rows=1000), analyzers)
+    for a in analyzers:
+        assert got.metric_map[a].value == ref.metric_map[a].value, a
+
+
 # -- encoded-vs-decoded bit-identity ----------------------------------------
 
 

@@ -228,6 +228,22 @@ def test_empty_stream():
     assert ctx.metric_map[Size()].value.get() == 0.0
 
 
+def test_size_only_stream_counts_rows():
+    """Row-count-only pruning regression (found by the round-9 chaos
+    probes): a LONE Size() prunes the stream read to zero columns, and a
+    zero-column batch cannot carry its row count — both streaming paths
+    must read one column to keep batch geometry, never fold Size=0."""
+    t = ColumnarTable.from_pydict({"x": [float(i) for i in range(97)]})
+    # fused streaming engine
+    ctx = AnalysisRunner.do_analysis_run(stream_table(t, 25), [Size()])
+    assert ctx.metric_map[Size()].value.get() == 97.0
+    # resilient per-batch loop (quarantine mode routes through it)
+    ctx = AnalysisRunner.do_analysis_run(
+        stream_table(t, 25), [Size()], on_batch_error="skip"
+    )
+    assert ctx.metric_map[Size()].value.get() == 97.0
+
+
 def test_streaming_incremental_states(mixed_table):
     """Streaming + save_states_with: states persisted from a streamed run
     must merge with later batches exactly like materialized ones."""
