@@ -547,6 +547,264 @@ def measure_reshard_overhead(n_rows: int):
     }
 
 
+def measure_serving_load(n_tenants: int, rows_per_tenant: int = 256):
+    """Serving-layer probe (round 10, deequ_tpu/serve — the config-1
+    millions-of-users shape): a synthetic ``n_tenants``-tenant OPEN-LOOP
+    load of small verification suites over a mix of REPEAT schemas (a
+    handful of suite shapes shared by many tenants — the plan-cache hot
+    path) and FRESH schemas (unique per tenant — the build path),
+    submitted all-at-once to a :class:`VerificationService` and served
+    coalesced. Reports sustained suites/sec, p50/p99 submit->resolve
+    latency, the plan-cache hit rate, and coalesced batch occupancy.
+
+    Contract asserts (the probe REFUSES to report on violation, like the
+    one-fetch and config-3 asserts):
+
+    - BIT-IDENTITY: every sampled tenant's coalesced metrics equal its
+      serial per-tenant ``VerificationSuite`` run bit-for-bit;
+    - REPEAT-TENANT ZERO TRACES: with plan lint armed, a repeat suite
+      after warmup adds zero ``programs_built`` and zero
+      ``plan_lint_traces`` and counts a ``plan_cache_hit``;
+    - ONE FETCH PER COALESCED BATCH: the load's device-fetch delta
+      equals its coalesced-batch delta exactly;
+    - >= 5x: sustained coalesced suites/sec over the serial
+      submit-per-run baseline (direct ``VerificationSuite.run`` per
+      tenant — what a caller without the serving layer does) measured
+      on the same harness, tables, and suites."""
+    import struct
+
+    from deequ_tpu import Check, CheckLevel, VerificationSuite
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.serve import VerificationService
+
+    rng = np.random.default_rng(17)
+    REPEAT_SHAPES = 8  # distinct suite shapes shared by repeat tenants
+    FRESH_FRAC = 0.02  # tenants with a one-off schema (plan builds)
+
+    def tenant_table(shape: int, seed: int, fresh_id=None):
+        r = np.random.default_rng(seed)
+        n = rows_per_tenant
+        cols = [
+            Column("x", DType.FRACTIONAL, values=r.normal(100 + shape, 5, n),
+                   mask=r.random(n) > 0.05),
+            Column("i", DType.INTEGRAL,
+                   values=r.integers(0, 40 + shape, n).astype(np.float64),
+                   mask=np.ones(n, dtype=np.bool_)),
+        ]
+        if fresh_id is not None:
+            # a fresh schema: a uniquely named extra column the suite
+            # reads, so the plan fingerprint cannot collide
+            cols.append(Column(
+                f"f{fresh_id}", DType.FRACTIONAL,
+                values=r.normal(0, 1, n), mask=np.ones(n, dtype=np.bool_),
+            ))
+        return ColumnarTable(cols)
+
+    def tenant_check(shape: int, fresh_id=None):
+        check = (
+            Check(CheckLevel.ERROR, f"suite-{shape}")
+            .has_size(lambda n: n == rows_per_tenant)
+            .is_complete("i")
+            .has_completeness("x", lambda c: c > 0.5)
+            .has_mean("x", lambda m, s=shape: 90 + s < m < 110 + s)
+        )
+        if fresh_id is not None:
+            check = check.has_completeness(f"f{fresh_id}", lambda c: c == 1.0)
+        return check
+
+    n_fresh = max(1, int(n_tenants * FRESH_FRAC))
+    load = []  # (tenant, table, checks)
+    for t in range(n_tenants):
+        if t < n_fresh:
+            load.append((f"fresh-{t}", tenant_table(0, 1000 + t, t),
+                         [tenant_check(0, t)]))
+        else:
+            shape = t % REPEAT_SHAPES
+            load.append((f"tenant-{t}", tenant_table(shape, t),
+                         [tenant_check(shape)]))
+
+    sample = load[:: max(1, n_tenants // 32)]  # bit-identity sample
+
+    def bits(v):
+        return struct.pack("<d", v) if isinstance(v, float) else v
+
+    with use_mesh(None):
+        # serial submit-per-run baseline on the same harness: one direct
+        # engine run per tenant. Run the slice twice and time the second
+        # pass — the STEADY-STATE cost (programs compiled), the same
+        # footing the sustained serving pass is gated on; an XLA compile
+        # costs ~0.3s on either side and would otherwise measure the
+        # compiler, not the serving layer.
+        # 64 runs bound the baseline's wall on the ~0.4s/suite tunnel
+        # while staying a stable denominator on fast hosts
+        baseline_slice = load[: min(64, n_tenants)]
+        for _, table, checks in baseline_slice:
+            VerificationSuite.run(table, checks)  # warm every program
+        serial_wall = float("inf")
+        for _ in range(3):  # min-of-reps, same as the sustained side
+            t0 = time.time()
+            for _, table, checks in baseline_slice:
+                VerificationSuite.run(table, checks)
+            serial_wall = min(serial_wall, time.time() - t0)
+        serial_persec = len(baseline_slice) / serial_wall
+
+        serial_sample = {
+            tenant: VerificationSuite.run(table, checks)
+            for tenant, table, checks in sample
+        }
+
+        # max_batch 256: the open-loop queue mixes REPEAT_SHAPES suite
+        # shapes, so a drained batch splits into per-plan groups of
+        # batch/shapes members — 256 keeps per-shape groups ~32 wide
+        service = VerificationService(plan_lint="error", max_batch=256)
+        try:
+            def run_pass():
+                t0 = time.time()
+                futures = [
+                    service.submit(table, checks, tenant=tenant)
+                    for tenant, table, checks in load
+                ]
+                results = {
+                    tenant: f.result(timeout=600)
+                    for (tenant, _, _), f in zip(load, futures)
+                }
+                return time.time() - t0, futures, results
+
+            # PASS 1 — cold: the mixed repeat/fresh load pays its plan
+            # builds, program traces, and lint traces here; its cache
+            # ledger is the reported hit rate for the mixed load
+            cold_before = SCAN_STATS.snapshot()
+            cold_wall, _, _ = run_pass()
+            cold_after = SCAN_STATS.snapshot()
+
+            # PASS 2/3 — sustained: every schema of the load is now
+            # cached; this is the steady-state serving rate the >=5x
+            # contract gates (fresh schemas of pass 1 are repeat
+            # tenants by now — exactly the Flare amortization claim).
+            # Min of three reps, the file's standard noise discipline.
+            wall = float("inf")
+            futures = results = None
+            before = after = None
+            for _ in range(3):
+                rep_before = SCAN_STATS.snapshot()
+                rep_wall, rep_futures, rep_results = run_pass()
+                rep_after = SCAN_STATS.snapshot()
+                if rep_wall < wall:
+                    wall = rep_wall
+                    futures, results = rep_futures, rep_results
+                    before, after = rep_before, rep_after
+
+            # repeat-tenant zero-trace contract (plan lint ARMED): the
+            # SECOND identical lone suite must be a pure hit. The first
+            # lone submit may trace the 1-wide tenant bucket (buckets
+            # are program shapes; the load ran wider batches) — that is
+            # the "first run" the contract's "second identical suite"
+            # is measured against.
+            service.submit(
+                tenant_table(1, 8887), [tenant_check(1)],
+                tenant="repeat-probe",
+            ).result(timeout=120)
+            built = SCAN_STATS.programs_built
+            lint_traces = SCAN_STATS.plan_lint_traces
+            hits = SCAN_STATS.plan_cache_hits
+            service.submit(
+                tenant_table(1, 8888), [tenant_check(1)],
+                tenant="repeat-probe",
+            ).result(timeout=120)
+            assert SCAN_STATS.programs_built == built, (
+                "serving violation: a repeat-tenant suite re-traced its "
+                "program (the compiled-plan cache missed)"
+            )
+            assert SCAN_STATS.plan_lint_traces == lint_traces, (
+                "serving violation: a repeat-tenant suite re-traced the "
+                "plan lint"
+            )
+            assert SCAN_STATS.plan_cache_hits == hits + 1, (
+                "serving violation: repeat-tenant suite did not count a "
+                "plan-cache hit"
+            )
+        finally:
+            service.stop(drain=False)
+
+    # bit-identity: sampled tenants' coalesced results == serial runs
+    for tenant, _, _ in sample:
+        s, c = serial_sample[tenant], results[tenant]
+        assert str(s.status) == str(c.status), (
+            f"serving violation: {tenant} status {c.status} != serial "
+            f"{s.status}"
+        )
+        for a, m1 in s.metrics.items():
+            m2 = c.metrics[a]
+            assert m1.value.is_success and m2.value.is_success, (tenant, a)
+            assert bits(m1.value.get()) == bits(m2.value.get()), (
+                f"serving violation: {tenant} {a} coalesced "
+                f"{m2.value.get()!r} != serial {m1.value.get()!r} — "
+                "coalesced results must be BIT-identical to per-tenant "
+                "serial runs"
+            )
+
+    batches = after["coalesced_batches"] - before["coalesced_batches"]
+    tenants_served = after["coalesced_tenants"] - before["coalesced_tenants"]
+    padded = after["coalesce_padded_slots"] - before["coalesce_padded_slots"]
+    fetches = after["device_fetches"] - before["device_fetches"]
+    assert tenants_served == n_tenants, (
+        f"serving violation: {n_tenants - tenants_served} of the load's "
+        "suites did not ride a coalesced dispatch"
+    )
+    assert fetches == batches, (
+        f"serving violation: {fetches} device fetches for {batches} "
+        "coalesced batches — the one-fetch-per-batch contract is gone"
+    )
+    suites_persec = n_tenants / max(wall, 1e-9)
+    speedup = suites_persec / max(serial_persec, 1e-9)
+    # the >=5x contract is defined on the 1k-tenant load (acceptance
+    # criterion); smaller (smoke-sized) loads amortize less — fewer,
+    # narrower batches — and keep a 3x floor so a dead coalescer still
+    # refuses while scheduler noise on a busy 1-vCPU host does not
+    floor = 5.0 if n_tenants >= 1000 else 3.0
+    assert speedup >= floor, (
+        f"serving violation: coalesced throughput {suites_persec:.0f} "
+        f"suites/s is only {speedup:.2f}x the serial submit-per-run "
+        f"baseline ({serial_persec:.0f} suites/s) — the >={floor:g}x "
+        f"serving contract ({n_tenants}-tenant load) is gone"
+    )
+    latencies = sorted(
+        f.latency_seconds for f in futures if f.latency_seconds is not None
+    )
+    # the MIXED (cold) pass's cache ledger: fresh schemas miss, repeat
+    # shapes hit — the hit rate the open-loop load actually saw
+    cold_hits = cold_after["plan_cache_hits"] - cold_before["plan_cache_hits"]
+    cold_misses = (
+        cold_after["plan_cache_misses"] - cold_before["plan_cache_misses"]
+    )
+    return {
+        "serving_suites_per_sec": round(suites_persec, 1),
+        "serving_cold_suites_per_sec": round(
+            n_tenants / max(cold_wall, 1e-9), 1
+        ),
+        "serving_serial_baseline_suites_per_sec": round(serial_persec, 1),
+        "serving_speedup_vs_serial": round(speedup, 2),
+        "serving_p50_latency_ms": round(
+            latencies[len(latencies) // 2] * 1000, 2
+        ),
+        "serving_p99_latency_ms": round(
+            latencies[int(len(latencies) * 0.99)] * 1000, 2
+        ),
+        "serving_plan_cache_hit_rate": round(
+            cold_hits / max(cold_hits + cold_misses, 1), 4
+        ),
+        "serving_batch_occupancy": round(
+            tenants_served / max(tenants_served + padded, 1), 4
+        ),
+        "serving_coalesced_batches": batches,
+        "serving_mean_batch_size": round(
+            tenants_served / max(batches, 1), 2
+        ),
+    }
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -678,9 +936,14 @@ def main():
         SMOKE_ROWS if smoke else 200_000
     )
     print(f"governance probe: {governance_probe}", file=sys.stderr)
+    # serving-layer probe (round 10): the 1k-tenant open-loop load with
+    # the bit-identity / zero-trace / one-fetch-per-batch / >=5x gates
+    # asserted inside
+    serving_probe = measure_serving_load(200 if smoke else 1000)
+    print(f"serving probe: {serving_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
-        **lint_probe, **ingest_probe, **governance_probe,
+        **lint_probe, **ingest_probe, **governance_probe, **serving_probe,
     }
 
     if smoke:

@@ -93,6 +93,25 @@ def config1():
     )
 
 
+def config6(n_tenants: int):
+    """SERVING config (round 10, deequ_tpu/serve): the config-1 shape at
+    fleet scale — an ``n_tenants`` open-loop load of small suites served
+    through the VerificationService's compiled-plan cache + request
+    coalescer. ONE workload definition, shared with bench.py's
+    ``measure_serving_load`` probe (which hard-asserts bit-identity vs
+    serial, the repeat-tenant zero-trace contract, one fetch per
+    coalesced batch, and the >=5x sustained-throughput gate before it
+    reports anything) — the suites/sec row lands next to rows/sec."""
+    import bench
+
+    probe = bench.measure_serving_load(n_tenants)
+    return _emit(
+        config=6, metric="serving_suites_per_sec", tenants=n_tenants,
+        value=probe["serving_suites_per_sec"], unit="suites/sec",
+        **{k: v for k, v in probe.items() if k != "serving_suites_per_sec"},
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -605,6 +624,9 @@ def main():
         # config 5 with batches read out-of-core from Parquet on disk
         # (python benchmarks/run_configs.py --config 50)
         50: lambda: config5_from_disk(20, (args.rows or 10_000_000) // 20),
+        # round-10 serving config: 1k-tenant open-loop suite load through
+        # the multi-tenant service (plan cache + coalescer), suites/sec
+        6: lambda: config6(args.rows or 1000),
     }
     if args.all:
         for k in sorted(runners):
@@ -617,7 +639,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5} or --all")
+        ap.error("--config {1,2,3,4,5,6} or --all")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,235 @@
+"""The DEEQU_TPU_* environment-variable registry — ONE validated parser.
+
+By round 9 the engine had grown eight-plus hand-rolled ``os.environ``
+parsers, each with its own validation posture: the kernel switches
+rejected anything but ``'' | '0' | '1'``, the scan window raised on
+non-integers, the governance deadlines silently swallowed garbage into
+"disabled", and nothing anywhere could LIST the switches a deployment
+was actually running under. This module is the consolidation the round-10
+serve switches land on instead of adding a ninth dialect:
+
+- :class:`EnvVar` — one registered variable: name, kind, default,
+  constraints, and the one-line doc the registry can print;
+- :func:`env_value` — the single parse/validate path. Malformed values
+  raise :class:`~deequ_tpu.exceptions.EnvConfigError` (a ``ValueError``
+  subclass, so existing ``except ValueError`` validation handling keeps
+  working) with the variable name, the offending value, and what would
+  have been accepted;
+- :func:`registry_snapshot` — {name: (raw, parsed, doc)} for every
+  registered variable, the "what is this process configured as"
+  observable (``python -m deequ_tpu.lint`` readers and execution
+  reports can dump it).
+
+Kinds (matching the semantics the scattered parsers had established,
+now uniform):
+
+- ``flag01`` — ``'' | '0' | '1'`` strictly; anything else raises
+  (the DEEQU_TPU_SELECT_KERNEL / DEEQU_TPU_ENCODED_INGEST posture,
+  now shared by every on/off switch);
+- ``lenient_flag`` — any value other than ``'0'`` is on (the historical
+  DEEQU_TPU_DEVICE_FOLD / DEEQU_TPU_FUSED_RESIDENT contract: scripts in
+  the wild export ``=yes``; tightening those two retroactively would
+  flip behavior under existing deployments);
+- ``int`` / ``float`` — parsed with optional ``minimum``; empty/unset
+  yields the default. ``zero_disables=True`` maps 0 (and negatives) to
+  None — the watchdog/deadline convention "0 means off";
+- ``choice`` — one of ``choices`` or empty (default).
+
+Variables parse STRICTLY by default: a typo like
+``DEEQU_TPU_RUN_DEADLINE=5m`` is a misconfiguration the run must refuse,
+not silently ignore (the pre-round-10 governance parsers disabled the
+budget on garbage — a deployment that THOUGHT it was governed wasn't).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from deequ_tpu.exceptions import EnvConfigError
+
+_KINDS = ("flag01", "lenient_flag", "int", "float", "choice", "str")
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One registered DEEQU_TPU_* variable (see module doc for kinds)."""
+
+    name: str
+    kind: str
+    default: Any = None
+    minimum: Optional[float] = None
+    zero_disables: bool = False
+    choices: Tuple[str, ...] = ()
+    doc: str = ""
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown EnvVar kind {self.kind!r}")
+        if self.kind == "choice" and not self.choices:
+            raise ValueError(f"{self.name}: choice kind needs choices")
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(var: EnvVar) -> EnvVar:
+    """Add one variable to the registry (idempotent for identical specs;
+    a conflicting re-registration is a programming error)."""
+    existing = _REGISTRY.get(var.name)
+    if existing is not None and existing != var:
+        raise ValueError(
+            f"conflicting registration for {var.name}: {existing} vs {var}"
+        )
+    _REGISTRY[var.name] = var
+    return var
+
+
+def _parse(var: EnvVar, raw: str) -> Any:
+    if var.kind == "flag01":
+        if raw not in ("0", "1"):
+            raise EnvConfigError(
+                var.name, raw, "'' (default), '0' (off) or '1' (on)"
+            )
+        return raw != "0"
+    if var.kind == "lenient_flag":
+        return raw != "0"
+    if var.kind == "int":
+        try:
+            val = int(raw)
+        except ValueError:
+            raise EnvConfigError(var.name, raw, "an integer") from None
+        return _bound(var, val)
+    if var.kind == "float":
+        try:
+            val = float(raw)
+        except ValueError:
+            raise EnvConfigError(var.name, raw, "a number") from None
+        return _bound(var, val)
+    if var.kind == "choice":
+        if raw not in var.choices:
+            raise EnvConfigError(
+                var.name, raw, f"one of {list(var.choices)}"
+            )
+        return raw
+    return raw  # "str"
+
+
+def _bound(var: EnvVar, val):
+    if var.zero_disables and val <= 0:
+        return None
+    if var.minimum is not None and val < var.minimum:
+        raise EnvConfigError(
+            var.name, str(val), f"a value >= {var.minimum:g}"
+        )
+    return val
+
+
+def env_value(name: str) -> Any:
+    """Parse + validate one registered variable from the process
+    environment. Unset/empty yields the registered default; malformed
+    values raise typed :class:`EnvConfigError`."""
+    var = _REGISTRY.get(name)
+    if var is None:
+        raise KeyError(f"{name} is not a registered DEEQU_TPU env var")
+    raw = os.environ.get(name, "")
+    if var.kind != "lenient_flag":
+        raw = raw.strip()
+    if raw == "":
+        return var.default
+    return _parse(var, raw)
+
+
+def registry_snapshot() -> Dict[str, dict]:
+    """{name: {raw, value|error, doc}} for every registered variable —
+    the configuration observable for execution reports."""
+    out: Dict[str, dict] = {}
+    for name, var in sorted(_REGISTRY.items()):
+        raw = os.environ.get(name)
+        row = {"raw": raw, "doc": var.doc, "kind": var.kind}
+        try:
+            row["value"] = env_value(name)
+        except EnvConfigError as e:
+            row["error"] = str(e)
+        out[name] = row
+    return out
+
+
+# -- the registered variables (one declaration point; the modules that
+#    consume them import these constants so the name can never drift
+#    from the parse site) ---------------------------------------------------
+
+SCAN_WINDOW = register(EnvVar(
+    "DEEQU_TPU_SCAN_WINDOW", "int", default=None, minimum=1,
+    doc="pipelined-dispatch window (chunks in flight) for fused scans",
+))
+DEVICE_FOLD = register(EnvVar(
+    "DEEQU_TPU_DEVICE_FOLD", "lenient_flag", default=True,
+    doc="0 reverts to the host-side per-chunk partial fold (A/B hatch)",
+))
+FUSED_RESIDENT = register(EnvVar(
+    "DEEQU_TPU_FUSED_RESIDENT", "lenient_flag", default=True,
+    doc="0 drops the single-dispatch fused resident loop (A/B hatch)",
+))
+TRANSFER_F32 = register(EnvVar(
+    "DEEQU_TPU_TRANSFER_F32", "flag01", default=False,
+    doc="1 ships fractional columns hi-plane only (lossy, opt-in)",
+))
+COMPUTE = register(EnvVar(
+    "DEEQU_TPU_COMPUTE", "choice", default=None, choices=("f64", "F64"),
+    doc="f64 opts out of the two-float compute path (slow, bit-exact)",
+))
+SELECT_KERNEL = register(EnvVar(
+    "DEEQU_TPU_SELECT_KERNEL", "flag01", default=True,
+    doc="0 keeps the device-sort quantile path (A/B hatch, PR 6)",
+))
+ENCODED_INGEST = register(EnvVar(
+    "DEEQU_TPU_ENCODED_INGEST", "flag01", default=True,
+    doc="0 packs every column decoded (A/B hatch, PR 8)",
+))
+DEVICE_DEADLINE = register(EnvVar(
+    "DEEQU_TPU_DEVICE_DEADLINE", "float", default=None,
+    zero_disables=True,
+    doc="compute-watchdog deadline (s) on blocking device calls",
+))
+SHARD_DEADLINE = register(EnvVar(
+    "DEEQU_TPU_SHARD_DEADLINE", "float", default=None,
+    zero_disables=True,
+    doc="per-shard straggler deadline (s) on multi-chip dispatches",
+))
+RUN_DEADLINE = register(EnvVar(
+    "DEEQU_TPU_RUN_DEADLINE", "float", default=None, zero_disables=True,
+    doc="run-level wall budget (s) for the composed fault ladder",
+))
+RUN_ATTEMPTS = register(EnvVar(
+    "DEEQU_TPU_RUN_ATTEMPTS", "int", default=None, zero_disables=True,
+    doc="run-level failure-attempt budget for the composed fault ladder",
+))
+ON_BUDGET_EXHAUSTED = register(EnvVar(
+    "DEEQU_TPU_ON_BUDGET_EXHAUSTED", "choice", default="degrade",
+    choices=("degrade", "raise"),
+    doc="run-budget exhaustion policy",
+))
+PLAN_LINT = register(EnvVar(
+    "DEEQU_TPU_PLAN_LINT", "choice", default="off",
+    choices=("error", "warn", "off"),
+    doc="static plan-lint enforcement mode for scan programs",
+))
+GROUP_MEMORY_BUDGET = register(EnvVar(
+    "DEEQU_TPU_GROUP_MEMORY_BUDGET", "int", default=None, minimum=1,
+    doc="host-RSS budget (bytes) for grouping state before spilling",
+))
+DISABLE_NATIVE = register(EnvVar(
+    "DEEQU_TPU_DISABLE_NATIVE", "lenient_flag", default=False,
+    doc="any non-'0' value disables the native (C-extension) kernels",
+))
+SERVE_MAX_BATCH = register(EnvVar(
+    "DEEQU_TPU_SERVE_MAX_BATCH", "int", default=64, minimum=1,
+    doc="max tenant suites coalesced into one packed dispatch (PR 10)",
+))
+SERVE_COALESCE_WINDOW = register(EnvVar(
+    "DEEQU_TPU_SERVE_COALESCE_WINDOW", "float", default=0.002,
+    minimum=0.0,
+    doc="seconds the serve worker waits for co-batchable submissions",
+))

@@ -22,6 +22,22 @@ class MetricCalculationException(Exception):
     """Base class for anything that goes wrong while computing a metric."""
 
 
+class EnvConfigError(ValueError):
+    """A malformed ``DEEQU_TPU_*`` environment variable
+    (deequ_tpu/envcfg.py — the consolidated registry every switch parses
+    through). Subclasses ``ValueError`` so pre-registry callers that
+    caught validation errors keep working; carries the variable name,
+    the offending raw value, and what would have been accepted, so a
+    deployment misconfiguration reads as exactly that instead of a
+    stack trace into whichever module happened to parse it first."""
+
+    def __init__(self, name: str, raw: str, expected: str):
+        super().__init__(f"{name} must be {expected}, got {raw!r}")
+        self.name = name
+        self.raw = raw
+        self.expected = expected
+
+
 class MetricCalculationRuntimeException(MetricCalculationException):
     """Runtime failure during state/metric computation."""
 
@@ -80,6 +96,22 @@ class ReusingNotPossibleResultsMissingException(
     Lives here so ALL failure types share one taxonomy; re-exported from
     ``analyzers.runner`` for compatibility, and still a RuntimeError for
     call sites that caught it as one before the move."""
+
+
+class ServeException(MetricCalculationRuntimeException):
+    """Base for serving-layer (deequ_tpu/serve) operational failures —
+    conditions of the SERVICE, not of any one suite's data (those stay
+    failure metrics / typed device errors as everywhere else)."""
+
+
+class ServiceClosedException(ServeException):
+    """A submit/resume/flush against a stopped VerificationService."""
+
+
+class ServiceOverloadedException(ServeException):
+    """Typed backpressure: the service's pending queue is at
+    ``max_pending`` — the caller sheds load or retries later; the
+    service never buffers without bound."""
 
 
 class RetryExhaustedException(MetricCalculationRuntimeException):

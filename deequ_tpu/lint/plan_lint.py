@@ -49,6 +49,16 @@ Rules (ids are stable; severities per ``findings.LintFinding``):
   forbids; re-asserted here per encoded program on top of
   ``plan-host-callback`` so the encoded rule is self-contained).
 
+PACKED multi-tenant plans (``ScanPlan.tenants > 0`` — the serve layer's
+coalesced dispatch, deequ_tpu/serve) run the same rules PLUS a
+per-member pass: each tenant slice's ``PackedMember`` declaration is
+re-checked against the shared vmapped program and group layout, so
+``plan-select-sort`` and ``plan-encoded-decode`` hold per slice (a
+finding names the member). Packed programs memoize under their OWN key
+— tenant-axis width + the member contract fingerprints on top of the
+program identity — so a packed plan can never inherit the verdict of
+its single-tenant twin or of a batch with different member contracts.
+
 Results are memoized per (program identity, variant, mesh) so
 enforcement costs one trace per plan/kernel-variant, not one per scan —
 the engine observes actual traces via ``ScanStats.plan_lint_traces``.
@@ -56,7 +66,6 @@ the engine observes actual traces via ``ScanStats.plan_lint_traces``.
 
 from __future__ import annotations
 
-import os
 from collections import Counter, OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -97,23 +106,18 @@ _MERGE_PROBES = {"sum": 5.0, "min": 2.0, "max": 3.0}
 
 def plan_lint_mode(param: Optional[str] = None) -> str:
     """Resolve the plan-lint enforcement mode: explicit argument wins,
-    then the DEEQU_TPU_PLAN_LINT env var, then "off". Validated against
-    PLAN_LINT_MODES (typed ValueError, like the select-kernel switch)."""
+    then the DEEQU_TPU_PLAN_LINT env var (envcfg registry), then "off".
+    Validated against PLAN_LINT_MODES (typed ValueError, like the
+    select-kernel switch)."""
+    from deequ_tpu.envcfg import env_value
+
     if param is not None:
         if param not in PLAN_LINT_MODES:
             raise ValueError(
                 f"plan_lint must be one of {PLAN_LINT_MODES}, got {param!r}"
             )
         return param
-    raw = os.environ.get("DEEQU_TPU_PLAN_LINT", "").strip()
-    if raw == "":
-        return "off"
-    if raw not in PLAN_LINT_MODES:
-        raise ValueError(
-            f"DEEQU_TPU_PLAN_LINT must be one of {PLAN_LINT_MODES}, "
-            f"got {raw!r}"
-        )
-    return raw
+    return env_value("DEEQU_TPU_PLAN_LINT")
 
 
 def iter_eqns(jaxpr):
@@ -315,6 +319,75 @@ def _check_encoded_ingest(plan_ir, census: Optional[Counter]) -> List[LintFindin
     return findings
 
 
+def _check_packed_members(plan_ir, census: Optional[Counter]) -> List[LintFinding]:
+    """Per-tenant-slice contract checks for a PACKED multi-tenant plan
+    (``ScanPlan.tenants > 0``, deequ_tpu/serve): every member shares ONE
+    vmapped program and ONE packer layout, so each member's DECLARED
+    contracts (``PackedMember``) are re-checked against that shared
+    reality — a sort primitive in the program while any member declares
+    the selection contract, or a member's declared encoded column riding
+    a pre-decoded plane of the group layout, is that member's violation
+    (location names the slice). Padding slots (all-invalid dummy slices)
+    declare nothing and are skipped."""
+    findings: List[LintFinding] = []
+    members = getattr(plan_ir, "members", ()) or ()
+    if not members:
+        return findings
+    layout = dict(plan_ir.layout or ())
+    enc_plane = set(layout.get("enc", ()))
+    sorts = (
+        sum(census.get(p, 0) for p in _SORT_PRIMITIVES)
+        if census is not None
+        else 0
+    )
+    for k, m in enumerate(members):
+        if getattr(m, "padding", False):
+            continue
+        where = f"member[{k}]={m.label}"
+        if m.variant == "select" and sorts:
+            findings.append(
+                LintFinding(
+                    "plan-select-sort",
+                    "error",
+                    f"packed tenant slice declares the selection contract "
+                    f"but the SHARED vmapped program contains {sorts} sort "
+                    "primitive(s): the zero-sort contract is violated for "
+                    "this member before dispatch",
+                    location=where,
+                )
+            )
+        if m.ingest_variant == "encoded":
+            for col in m.encoded_columns:
+                on_decoded = [
+                    p for p in _DECODED_PLANES if col in layout.get(p, ())
+                ]
+                if on_decoded:
+                    findings.append(
+                        LintFinding(
+                            "plan-encoded-decode",
+                            "error",
+                            f"packed tenant slice declares encoded column "
+                            f"{col!r} but the GROUP layout routes it over "
+                            f"pre-decoded plane(s) {on_decoded}: this "
+                            "member's decoded values would ship while its "
+                            "plan claims the encoded form",
+                            location=f"{where} column={col}",
+                        )
+                    )
+                elif col not in enc_plane:
+                    findings.append(
+                        LintFinding(
+                            "plan-encoded-decode",
+                            "error",
+                            f"packed tenant slice declares encoded column "
+                            f"{col!r} which is on no plane of the group "
+                            "layout: coalescer/packer drift",
+                            location=f"{where} column={col}",
+                        )
+                    )
+    return findings
+
+
 def lint_plan(
     plan_ir,
     trace_fn: Optional[Callable] = None,
@@ -324,7 +397,10 @@ def lint_plan(
     ``ops/scan_plan.ScanPlan``) and, when ``trace_fn`` is given, the
     jaxpr of ``trace_fn(*avals)`` — the fused flat step the executor
     will jit. Returns the findings, errors first; empty means the
-    program satisfies every declared contract."""
+    program satisfies every declared contract. Packed multi-tenant
+    plans (``tenants > 0``) additionally re-check each member slice's
+    declared contracts against the shared program/layout
+    (:func:`_check_packed_members`)."""
     import jax
 
     findings: List[LintFinding] = []
@@ -336,11 +412,13 @@ def lint_plan(
     if trace_fn is None:
         # layout-only encoded checks still run without a traced program
         findings += _check_encoded_ingest(plan_ir, None)
+        findings += _check_packed_members(plan_ir, None)
 
     if trace_fn is not None:
         closed = jax.make_jaxpr(trace_fn)(*avals)
         census = primitive_census(closed)
         findings += _check_encoded_ingest(plan_ir, census)
+        findings += _check_packed_members(plan_ir, census)
         sorts = sum(census.get(p, 0) for p in _SORT_PRIMITIVES)
         if plan_ir.variant == "select" and sorts:
             findings.append(

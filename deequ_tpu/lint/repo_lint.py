@@ -69,10 +69,13 @@ from deequ_tpu.lint.findings import LintFinding
 #: rule id -> package-relative path prefixes it applies to ("" = whole
 #: package). Paths use "/" regardless of platform.
 RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
-    "host-fetch": ("ops/", "parallel/", "anomaly/"),
-    "bare-except": ("ops/", "parallel/", "resilience/"),
+    # serve/ is device-adjacent (round 10): its coalesced executor
+    # fetches and its worker loop wraps device seams, so the host-fetch
+    # accounting and typed-raise disciplines apply there in full
+    "host-fetch": ("ops/", "parallel/", "anomaly/", "serve/"),
+    "bare-except": ("ops/", "parallel/", "resilience/", "serve/"),
     "jit-impure": ("",),
-    "typed-raise": ("ops/", "resilience/"),
+    "typed-raise": ("ops/", "resilience/", "serve/"),
     "suppress-reason": ("",),
 }
 

@@ -43,7 +43,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if _load_attempted:
             return _lib
         _load_attempted = True
-        if os.environ.get("DEEQU_TPU_DISABLE_NATIVE"):
+        from deequ_tpu.envcfg import env_value
+
+        if env_value("DEEQU_TPU_DISABLE_NATIVE"):
             return None
         needs_build = (
             not os.path.exists(_SO)

@@ -33,7 +33,6 @@ chip) is still trusted.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Any, Callable, Dict, Optional
 
@@ -70,27 +69,25 @@ def current_scan_fault_hook():
 
 def default_device_deadline() -> Optional[float]:
     """Process-wide watchdog deadline (seconds) from
-    ``DEEQU_TPU_DEVICE_DEADLINE``; unset/empty/0 disables the watchdog."""
-    raw = os.environ.get("DEEQU_TPU_DEVICE_DEADLINE", "")
-    try:
-        val = float(raw)
-    except ValueError:
-        return None
-    return val if val > 0 else None
+    ``DEEQU_TPU_DEVICE_DEADLINE`` (envcfg registry); unset/empty/0
+    disables the watchdog, malformed values raise typed
+    ``EnvConfigError`` (pre-round-10 this silently disarmed the
+    watchdog a deployment thought it had armed)."""
+    from deequ_tpu.envcfg import env_value
+
+    return env_value("DEEQU_TPU_DEVICE_DEADLINE")
 
 
 def default_shard_deadline() -> Optional[float]:
     """Process-wide per-shard dispatch deadline (seconds) from
-    ``DEEQU_TPU_SHARD_DEADLINE``, armed only on MULTI-CHIP mesh scans: a
-    straggling chip that stalls a collective past it raises
-    ``DeviceHangException`` (recorded as a ``mesh_straggler`` event)
-    instead of freezing the whole mesh. Unset/empty/0 disables it."""
-    raw = os.environ.get("DEEQU_TPU_SHARD_DEADLINE", "")
-    try:
-        val = float(raw)
-    except ValueError:
-        return None
-    return val if val > 0 else None
+    ``DEEQU_TPU_SHARD_DEADLINE`` (envcfg registry), armed only on
+    MULTI-CHIP mesh scans: a straggling chip that stalls a collective
+    past it raises ``DeviceHangException`` (recorded as a
+    ``mesh_straggler`` event) instead of freezing the whole mesh.
+    Unset/empty/0 disables it; malformed values raise typed."""
+    from deequ_tpu.envcfg import env_value
+
+    return env_value("DEEQU_TPU_SHARD_DEADLINE")
 
 
 class _WatchdogPool:

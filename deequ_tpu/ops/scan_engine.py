@@ -105,19 +105,14 @@ MAX_FOLD_CAPACITY = 1024
 
 def _resolve_scan_window(window: Optional[int] = None) -> int:
     """The pipelined-dispatch window: explicit argument wins, then the
-    DEEQU_TPU_SCAN_WINDOW env var, then DEFAULT_SCAN_WINDOW. Validated
-    >= 1 (a zero/negative window would deadlock the dispatch loop)."""
+    DEEQU_TPU_SCAN_WINDOW env var (envcfg registry), then
+    DEFAULT_SCAN_WINDOW. Validated >= 1 (a zero/negative window would
+    deadlock the dispatch loop)."""
+    from deequ_tpu.envcfg import env_value
+
     if window is None:
-        raw = os.environ.get("DEEQU_TPU_SCAN_WINDOW", "").strip()
-        if raw:
-            try:
-                window = int(raw)
-            except ValueError:
-                raise ValueError(
-                    f"DEEQU_TPU_SCAN_WINDOW must be an integer >= 1, "
-                    f"got {raw!r}"
-                ) from None
-        else:
+        window = env_value("DEEQU_TPU_SCAN_WINDOW")
+        if window is None:
             window = DEFAULT_SCAN_WINDOW
     window = int(window)
     if window < 1:
@@ -129,7 +124,9 @@ def _device_fold_enabled() -> bool:
     """Escape hatch: DEEQU_TPU_DEVICE_FOLD=0 reverts to the host-side
     per-chunk partial fold (one device->host fetch PER CHUNK instead of
     per scan) — for A/B numerics comparison and emergencies."""
-    return os.environ.get("DEEQU_TPU_DEVICE_FOLD", "1") != "0"
+    from deequ_tpu.envcfg import env_value
+
+    return env_value("DEEQU_TPU_DEVICE_FOLD")
 
 
 def _fused_resident_enabled() -> bool:
@@ -140,7 +137,9 @@ def _fused_resident_enabled() -> bool:
     program; documented in docs/numerics.md). DEEQU_TPU_FUSED_RESIDENT=0
     keeps the per-chunk device fold (bit-identical to the host fold,
     still one fetch) while dropping only the single-dispatch fusion."""
-    return os.environ.get("DEEQU_TPU_FUSED_RESIDENT", "1") != "0"
+    from deequ_tpu.envcfg import env_value
+
+    return env_value("DEEQU_TPU_FUSED_RESIDENT")
 
 
 def device_foldable(op: "ScanOp") -> bool:
@@ -332,6 +331,19 @@ class ScanStats:
         # behind bench.py's measure_governance_overhead <1% contract
         self.budget_charges = 0
         self.budget_exhaustions = 0
+        # serving layer (deequ_tpu/serve, round 10): compiled-plan cache
+        # traffic — a HIT means the suite ran with zero new traces, zero
+        # compiles, and zero plan-lint traces (the hard repeat-tenant
+        # contract measure_serving_load asserts); a MISS pays the
+        # one-time build. Coalescing telemetry: packed multi-tenant
+        # dispatches, real tenant suites they carried, and padding slots
+        # burned to reach the tenant-axis bucket (occupancy =
+        # coalesced_tenants / (coalesced_tenants + coalesce_padded_slots))
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.coalesced_batches = 0
+        self.coalesced_tenants = 0
+        self.coalesce_padded_slots = 0
 
     @property
     def ingest_overlap_frac(self) -> float:
@@ -486,18 +498,18 @@ def _transfer_f32() -> bool:
     (half the bytes) and compute with lo = 0. Metric values then reflect
     f32-rounded inputs — acceptable for profiling/monitoring, off by
     default."""
-    import os
+    from deequ_tpu.envcfg import env_value
 
-    return os.environ.get("DEEQU_TPU_TRANSFER_F32", "0") == "1"
+    return env_value("DEEQU_TPU_TRANSFER_F32")
 
 
 def _compute_f64() -> bool:
     """Opt-out of the two-float compute path: fractional columns ship and
     compute as f64 (the pre-round-4 behavior; ~10x slower device compute
     on TPU, bit-identical to host f64 math)."""
-    import os
+    from deequ_tpu.envcfg import env_value
 
-    return os.environ.get("DEEQU_TPU_COMPUTE", "").lower() == "f64"
+    return env_value("DEEQU_TPU_COMPUTE") is not None
 
 
 def _enc_eligible(col: Column) -> bool:

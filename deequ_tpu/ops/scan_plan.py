@@ -36,7 +36,6 @@ The resolved plan also carries the per-chunk kernel census
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, replace
 from typing import Optional, Sequence, Tuple
 
@@ -51,43 +50,38 @@ KNOWN_FOLD_TAGS = frozenset(("sum", "min", "max", "gather"))
 def encoded_ingest_enabled(param: Optional[bool] = None) -> bool:
     """Resolve the encoded-ingest switch: explicit argument wins, then
     the DEEQU_TPU_ENCODED_INGEST env var ('0' disables — the A/B and
-    regression-triage escape hatch, mirroring DEEQU_TPU_SELECT_KERNEL),
-    then on. When on, columns carrying a dictionary encoding ride the
-    int16 ``enc`` plane (codes only over the tunnel; decode is a
-    dictionary gather fused into the scan program); off routes every
-    column through the decoded planes exactly as before round 8."""
+    regression-triage escape hatch, mirroring DEEQU_TPU_SELECT_KERNEL;
+    parsed via the deequ_tpu/envcfg registry), then on. When on, columns
+    carrying a dictionary encoding ride the int16 ``enc`` plane (codes
+    only over the tunnel; decode is a dictionary gather fused into the
+    scan program); off routes every column through the decoded planes
+    exactly as before round 8."""
+    from deequ_tpu.envcfg import env_value
+
     if param is not None:
         if not isinstance(param, (bool, int)) or param not in (0, 1):
             raise ValueError(
                 f"encoded_ingest must be True/False, got {param!r}"
             )
         return bool(param)
-    raw = os.environ.get("DEEQU_TPU_ENCODED_INGEST", "").strip()
-    if raw not in ("", "0", "1"):
-        raise ValueError(
-            f"DEEQU_TPU_ENCODED_INGEST must be '0' or '1', got {raw!r}"
-        )
-    return raw != "0"
+    return env_value("DEEQU_TPU_ENCODED_INGEST")
 
 
 def select_kernel_enabled(param: Optional[bool] = None) -> bool:
     """Resolve the selection-kernel switch: explicit argument wins, then
     the DEEQU_TPU_SELECT_KERNEL env var ('0' disables — the A/B and
-    regression-triage escape hatch, mirroring DEEQU_TPU_FUSED_RESIDENT),
-    then on. Validated: the argument must be bool-like, the env var one
-    of '', '0', '1'."""
+    regression-triage escape hatch, mirroring DEEQU_TPU_FUSED_RESIDENT;
+    parsed via the deequ_tpu/envcfg registry), then on. Validated: the
+    argument must be bool-like, the env var one of '', '0', '1'."""
+    from deequ_tpu.envcfg import env_value
+
     if param is not None:
         if not isinstance(param, (bool, int)) or param not in (0, 1):
             raise ValueError(
                 f"select_kernel must be True/False, got {param!r}"
             )
         return bool(param)
-    raw = os.environ.get("DEEQU_TPU_SELECT_KERNEL", "").strip()
-    if raw not in ("", "0", "1"):
-        raise ValueError(
-            f"DEEQU_TPU_SELECT_KERNEL must be '0' or '1', got {raw!r}"
-        )
-    return raw != "0"
+    return env_value("DEEQU_TPU_SELECT_KERNEL")
 
 
 @dataclass(frozen=True)
@@ -142,6 +136,66 @@ class ScanPlan:
     #: hashable snapshot of the packer layout (tuple of (plane, names)),
     #: None when the attempt has no packer yet (streams before batch 1)
     layout: Optional[Tuple] = None
+    #: multi-tenant PACKED plan (deequ_tpu/serve, round 10): the number
+    #: of tenant slices (padded slots included) the executor vmaps the
+    #: shared program over. 0 = an ordinary single-tenant plan. A packed
+    #: plan's one-fetch contract is per coalesced BATCH: one (K, S)
+    #: result materialization for K tenant suites.
+    tenants: int = 0
+    #: per-member declared contracts (PackedMember rows) the plan lint
+    #: re-checks against the SHARED traced program — a sort smuggled in
+    #: while any member declares the selection contract, or a member's
+    #: encoded column arriving pre-decoded on the group layout, is a
+    #: per-slice violation even though the program is shared
+    members: Tuple = ()
+
+
+@dataclass(frozen=True)
+class PackedMember:
+    """One tenant slice's DECLARED contracts inside a packed plan.
+
+    ``label`` identifies the member in lint findings (tenant id / slice
+    index); the remaining fields mirror the ScanPlan contract fields the
+    lint checks per slice. In a healthy coalesced batch every member's
+    declaration equals the shared plan's (the coalescer admits only
+    same-plan suites); a disagreement is planner drift the
+    ``plan-select-sort`` / ``plan-encoded-decode`` rules reject
+    pre-dispatch, per member."""
+
+    label: str
+    variant: str = "sort"
+    ingest_variant: str = "decoded"
+    encoded_columns: Tuple[str, ...] = ()
+    #: True marks a PADDING slot (an all-invalid dummy slice the
+    #: executor appends to reach the tenant-axis bucket; its result is
+    #: discarded) — the lint skips contract checks for it
+    padding: bool = False
+
+
+def plan_packed_scan(
+    ops: Sequence,
+    packer=None,
+    members: Sequence[PackedMember] = (),
+    select_kernel: Optional[bool] = None,
+) -> "ScanPlan":
+    """Resolve the multi-tenant PACKED plan (deequ_tpu/serve): one shared
+    op list the coalesced executor vmaps over a leading tenant axis,
+    ``members`` declaring each slice's contracts.
+
+    Packed members are packed fresh per batch and never device-resident,
+    so kernel resolution always lands on the sort path (exactly what the
+    serial baseline runs for a non-persisted table — the bit-identity
+    contract's requirement); the tenant axis rides vmap, whose per-slice
+    independence is what makes padding slots provably inert. The plan's
+    fetch contract is one fetch per coalesced BATCH."""
+    base = plan_scan_ops(
+        ops, packer, resident=False, select_kernel=select_kernel
+    )
+    return replace(
+        base,
+        tenants=len(members),
+        members=tuple(members),
+    )
 
 
 def _selectable(op, packer) -> bool:

@@ -44,7 +44,6 @@ terminates (typed) within ``run_deadline``.
 from __future__ import annotations
 
 import copy
-import os
 import threading
 import time
 from contextlib import contextmanager
@@ -59,24 +58,21 @@ _EXHAUST_MODES = ("degrade", "raise")
 
 def default_run_deadline() -> Optional[float]:
     """Process-wide run wall deadline (seconds) from
-    ``DEEQU_TPU_RUN_DEADLINE``; unset/empty/0 disables it."""
-    raw = os.environ.get("DEEQU_TPU_RUN_DEADLINE", "")
-    try:
-        val = float(raw)
-    except ValueError:
-        return None
-    return val if val > 0 else None
+    ``DEEQU_TPU_RUN_DEADLINE`` (envcfg registry); unset/empty/0 disables
+    it, malformed values raise typed ``EnvConfigError`` — a deployment
+    that thinks it is governed must not silently run ungoverned."""
+    from deequ_tpu.envcfg import env_value
+
+    return env_value("DEEQU_TPU_RUN_DEADLINE")
 
 
 def default_max_total_attempts() -> Optional[int]:
-    """Process-wide attempt budget from ``DEEQU_TPU_RUN_ATTEMPTS``;
-    unset/empty/0 disables it."""
-    raw = os.environ.get("DEEQU_TPU_RUN_ATTEMPTS", "")
-    try:
-        val = int(raw)
-    except ValueError:
-        return None
-    return val if val > 0 else None
+    """Process-wide attempt budget from ``DEEQU_TPU_RUN_ATTEMPTS``
+    (envcfg registry); unset/empty/0 disables it, malformed values raise
+    typed."""
+    from deequ_tpu.envcfg import env_value
+
+    return env_value("DEEQU_TPU_RUN_ATTEMPTS")
 
 
 @dataclass(frozen=True)
@@ -263,11 +259,12 @@ def resolve_run_policy(
         if max_total_attempts is not None
         else default_max_total_attempts()
     )
-    mode = (
-        on_budget_exhausted
-        if on_budget_exhausted is not None
-        else os.environ.get("DEEQU_TPU_ON_BUDGET_EXHAUSTED") or "degrade"
-    )
+    if on_budget_exhausted is not None:
+        mode = on_budget_exhausted
+    else:
+        from deequ_tpu.envcfg import env_value
+
+        mode = env_value("DEEQU_TPU_ON_BUDGET_EXHAUSTED")
     if deadline is None and attempts is None:
         if on_budget_exhausted is not None:
             raise ValueError(

@@ -1,0 +1,54 @@
+"""deequ_tpu.serve — the long-lived multi-tenant verification service.
+
+The millions-of-users shape (BENCHMARKS config 1) is many SMALL suites
+arriving concurrently, not one giant scan — and per submitted run the
+engine pays fixed costs that dwarf the compute at small row counts: a
+trace+compile for any fresh plan, a plan-lint trace, and a dispatch +
+fetch round trip (~4 fixed-latency tunnel round trips per run). Flare's
+thesis (arXiv:1703.08219) is that native whole-query compilation only
+wins when its cost is amortized across repeated executions; this package
+is that amortization for deequ-tpu (ROADMAP item 2, closing item 5's
+plan/executor split another notch):
+
+- :mod:`plan_cache` — the COMPILED-PLAN CACHE: suites are fingerprinted
+  by (schema, analyzer set — predicates included, packer layout, row
+  count) and a repeat tenant reuses the built ops, the traced+compiled
+  vmapped program, and the memoized plan-lint verdict. Observable as
+  ``ScanStats.plan_cache_hits`` / ``plan_cache_misses``; the hard
+  contract (bench + tier-1) is that a repeat suite adds ZERO traces.
+- :mod:`executor` — the REQUEST COALESCER's packed executor: N pending
+  tenant tables pack into ONE ``(K, n)`` buffer stack and run as ONE
+  vmapped fused dispatch with ONE device->host fetch (per-tenant state
+  slices unpacked on the host), so the round-trip cost is paid once per
+  BATCH of runs. Members coalesce only on exact (plan, layout, row
+  count) agreement — per-slice results are bit-identical to serial
+  per-tenant runs (the run_scan_group construction, vmap semantics);
+  the tenant axis pads to a pow2 bucket with all-invalid dummy slices
+  whose inertness vmap's per-slice independence guarantees. Faults
+  bisect the TENANT axis (split, retry halves) so one poison tenant is
+  localized in O(log K) and degrades only its own slice.
+- :mod:`service` — :class:`VerificationService`: the async
+  ``submit(...) -> VerificationFuture`` API, a bounded worker loop with
+  a coalescing window, per-tenant run budgets (PR 9 governance; one
+  tenant's budget exhaustion never sinks a batch), tenant quarantine
+  for repeat offenders, and kill-and-resume of the pending queue.
+
+See docs/serving.md for cache-key semantics, coalescing/padding rules,
+and the isolation ladder.
+"""
+
+from deequ_tpu.serve.plan_cache import PlanCache, PlanKey, ServePlan
+from deequ_tpu.serve.service import (
+    ServeConfig,
+    VerificationFuture,
+    VerificationService,
+)
+
+__all__ = [
+    "PlanCache",
+    "PlanKey",
+    "ServePlan",
+    "ServeConfig",
+    "VerificationFuture",
+    "VerificationService",
+]

@@ -1,0 +1,818 @@
+"""VerificationService — async multi-tenant verification serving.
+
+``submit(table, checks, tenant=...)`` returns a
+:class:`VerificationFuture` immediately; ONE bounded worker thread
+drains the pending queue, groups co-batchable suites (same
+:class:`~deequ_tpu.serve.plan_cache.PlanKey` — schema, analyzers,
+layout, row count), and executes each group as one coalesced dispatch
+(:mod:`deequ_tpu.serve.executor`). Suites the fast path cannot take
+(grouping/own-pass analyzers, dictionary-baked predicates, streaming or
+multi-chunk tables, an active device mesh) run per-tenant through the
+ordinary ``VerificationSuite`` engine — same results, no coalescing.
+
+Isolation ladder (the PR-3/5 fault ladder applied per coalesced
+dispatch, with per-tenant blast-radius control on top):
+
+1. a classified device fault during a coalesced dispatch BISECTS the
+   tenant axis: the batch splits in half and each half retries — a
+   poison tenant is localized in O(log K) while every healthy member
+   still completes (each split retry charges the members' own run
+   budgets, kind ``coalesce_retry``);
+2. a member that still faults alone falls back to the SERIAL per-tenant
+   path, where ``run_scan``'s full ladder (OOM bisection, encoded
+   demotion, CPU fallback) applies under that member's budget scope;
+3. a member whose budget exhausts degrades ONLY its own slice — typed
+   failure metrics on its result (``on_budget_exhausted="degrade"``) or
+   a typed rejection (``"raise"``), never the batch;
+4. a tenant that keeps failing is QUARANTINED (a
+   ``tenant_quarantine`` degradation event): its later submissions are
+   excluded from coalescing and served serially until one succeeds, so
+   a repeat offender cannot keep forcing batch bisections.
+
+Kill-and-resume: ``stop(drain=False)`` halts the worker after the
+in-flight batch and returns the still-pending requests; a fresh
+service's ``resume(pending)`` re-enqueues them onto the SAME futures, so
+a supervisor can recycle a worker process without dropping accepted
+work.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import CancelledError
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.exceptions import (
+    DeviceException,
+    PlanLintError,
+    RunBudgetExhaustedException,
+    ServiceClosedException,
+    ServiceOverloadedException,
+    wrap_if_necessary,
+)
+
+
+@dataclass
+class ServeConfig:
+    """Service knobs. ``max_batch`` / ``coalesce_window`` default from
+    the DEEQU_TPU_SERVE_MAX_BATCH / DEEQU_TPU_SERVE_COALESCE_WINDOW env
+    vars (deequ_tpu/envcfg registry). ``run_policy`` is the per-tenant
+    default fault budget (resilience/governance.RunPolicy; None =
+    ungoverned unless a submit overrides); ``quarantine_after`` is the
+    consecutive-failure threshold that parks a tenant on the serial
+    path."""
+
+    max_batch: Optional[int] = None
+    coalesce_window: Optional[float] = None
+    max_pending: int = 4096
+    run_policy: Any = None
+    on_device_error: str = "fail"
+    plan_lint: Optional[str] = None
+    quarantine_after: int = 2
+    plan_cache_size: int = 256
+
+    def __post_init__(self):
+        from deequ_tpu.envcfg import env_value
+
+        if self.max_batch is None:
+            self.max_batch = env_value("DEEQU_TPU_SERVE_MAX_BATCH")
+        if self.coalesce_window is None:
+            self.coalesce_window = env_value(
+                "DEEQU_TPU_SERVE_COALESCE_WINDOW"
+            )
+        self.max_batch = int(self.max_batch)
+        if self.max_batch < 1:
+            raise ValueError(
+                f"max_batch must be >= 1, got {self.max_batch}"
+            )
+        self.coalesce_window = float(self.coalesce_window)
+        if self.coalesce_window < 0:
+            raise ValueError("coalesce_window must be >= 0 seconds")
+        if self.on_device_error not in ("fail", "fallback"):
+            raise ValueError(
+                f"on_device_error must be 'fail' or 'fallback', "
+                f"got {self.on_device_error!r}"
+            )
+        if self.quarantine_after < 1:
+            raise ValueError("quarantine_after must be >= 1")
+
+
+class VerificationFuture:
+    """Handle for one submitted suite. ``result(timeout)`` blocks for
+    the :class:`~deequ_tpu.verification.VerificationResult` (re-raising
+    a typed failure); ``cancel()`` succeeds only while the request is
+    still queued."""
+
+    def __init__(self, tenant):
+        self.tenant = tenant
+        self.submitted_at = time.monotonic()
+        self.resolved_at: Optional[float] = None
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self._started = False
+        self._lock = threading.Lock()
+
+    # -- consumer side ---------------------------------------------------
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def cancel(self) -> bool:
+        """Cancel if still pending. Returns True when the request will
+        never execute; False when it already started (or finished)."""
+        with self._lock:
+            if self._started or self._done.is_set():
+                return False
+            self._cancelled = True
+        self._done.set()
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                "verification result not ready within "
+                f"{timeout if timeout is not None else 'inf'}s"
+            )
+        if self._cancelled:
+            raise CancelledError()
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def latency_seconds(self) -> Optional[float]:
+        if self.resolved_at is None:
+            return None
+        return self.resolved_at - self.submitted_at
+
+    # -- service side ----------------------------------------------------
+
+    def _claim(self) -> bool:
+        """Mark started; False when the consumer already cancelled."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._started = True
+            return True
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self.resolved_at = time.monotonic()
+        self._done.set()
+
+    def _reject(self, error: BaseException) -> None:
+        self._error = error
+        self.resolved_at = time.monotonic()
+        self._done.set()
+
+
+@dataclass
+class ServeRequest:
+    """One queued suite (internal; returned by ``stop(drain=False)`` for
+    resume)."""
+
+    data: Any
+    checks: Tuple
+    required_analyzers: Tuple
+    tenant: Any
+    run_policy: Any
+    future: VerificationFuture
+    #: filled at admission: the dedup'd analyzers + the plan fingerprint
+    analyzers: Tuple = ()
+    key: Any = None
+    coalescable: bool = False
+    #: the admission-time packer (layout already validated against the
+    #: plan key) — reused by the executor so members pack without a
+    #: second classification pass
+    packer: Any = None
+
+
+class _TenantHealth:
+    """Consecutive-failure ledger behind tenant quarantine (half-open:
+    one serial success readmits the tenant to coalescing)."""
+
+    def __init__(self, threshold: int):
+        self.threshold = threshold
+        self.failures: Dict[Any, int] = {}
+        self.quarantined: set = set()
+
+    def record_failure(self, tenant) -> bool:
+        """Count one failure; True when this crossed the quarantine
+        threshold (the caller records the degradation event)."""
+        if tenant is None:
+            return False
+        n = self.failures.get(tenant, 0) + 1
+        self.failures[tenant] = n
+        if n >= self.threshold and tenant not in self.quarantined:
+            self.quarantined.add(tenant)
+            return True
+        return False
+
+    def record_success(self, tenant) -> None:
+        if tenant is None:
+            return
+        self.failures.pop(tenant, None)
+        self.quarantined.discard(tenant)
+
+    def is_quarantined(self, tenant) -> bool:
+        return tenant is not None and tenant in self.quarantined
+
+
+class VerificationService:
+    """The long-lived serving entry point (see module doc)."""
+
+    def __init__(self, config: Optional[ServeConfig] = None, start: bool = True,
+                 **knobs):
+        from deequ_tpu.parallel.mesh import current_mesh
+        from deequ_tpu.serve.plan_cache import PlanCache
+
+        self.config = config if config is not None else ServeConfig(**knobs)
+        self.plan_cache = PlanCache(self.config.plan_cache_size)
+        self.tenant_health = _TenantHealth(self.config.quarantine_after)
+        # the mesh is thread-local: capture the constructing thread's
+        # resolution so the worker executes under the same device view
+        # (coalescing requires the single-device view; under a mesh
+        # every suite runs the serial sharded path for bit-identity
+        # with what the caller would have computed inline)
+        self._mesh = current_mesh()
+        #: analyzer_sig -> (needed columns, predicate mark set,
+        #: scan-only?) — discovered by the first HEALTHY op build (see
+        #: _admit). LRU-bounded like the plan cache beside it: a
+        #: long-lived service meeting unbounded distinct analyzer sets
+        #: (per-tenant predicates) must not grow host state forever
+        from deequ_tpu.ops.scan_engine import _BoundedLRU
+
+        self._families = _BoundedLRU(4 * self.config.plan_cache_size)
+        # service-lifetime switch resolution: one env read at
+        # construction, not one per admitted request
+        from deequ_tpu.lint.plan_lint import plan_lint_mode
+        from deequ_tpu.ops.scan_plan import encoded_ingest_enabled
+
+        self._encode = encoded_ingest_enabled(None)
+        self._lint_mode = plan_lint_mode(self.config.plan_lint)
+        self._cv = threading.Condition()
+        self._pending: deque = deque()
+        self._running = False
+        self._closed = False
+        self._idle = True
+        self._thread: Optional[threading.Thread] = None
+        self.batches_served = 0
+        self.suites_served = 0
+        if start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        with self._cv:
+            if self._closed:
+                raise ServiceClosedException("service is stopped")
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="deequ-tpu-serve"
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> List[ServeRequest]:
+        """Stop the worker. ``drain=True`` serves everything already
+        queued first and returns []; ``drain=False`` stops after the
+        in-flight batch and RETURNS the still-pending requests (their
+        futures unresolved) for :meth:`resume` on another service."""
+        if drain:
+            self.flush()
+        with self._cv:
+            self._closed = True
+            self._running = False
+            pending = list(self._pending)
+            self._pending.clear()
+            self._cv.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+        return pending
+
+    def resume(self, pending: Sequence[ServeRequest]) -> None:
+        """Adopt another (stopped) service's pending requests: they
+        re-enter this service's queue and resolve their ORIGINAL
+        futures."""
+        with self._cv:
+            if self._closed:
+                raise ServiceClosedException("service is stopped")
+            for req in pending:
+                self._pending.append(req)
+            self._cv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until the queue is empty and the worker is idle."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending or not self._idle:
+                if not self._running and self._pending:
+                    raise ServiceClosedException(
+                        "service stopped with requests pending"
+                    )
+                wait = None
+                if deadline is not None:
+                    wait = deadline - time.monotonic()
+                    if wait <= 0:
+                        raise TimeoutError("flush timed out")
+                self._cv.wait(wait if wait is not None else 0.1)
+
+    def __enter__(self) -> "VerificationService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(drain=exc_type is None)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(
+        self,
+        data,
+        checks: Sequence = (),
+        required_analyzers: Sequence = (),
+        tenant=None,
+        run_policy=None,
+    ) -> VerificationFuture:
+        """Enqueue one verification suite; returns its future. The
+        suite's fault budget is ``run_policy`` (or the service default);
+        backpressure is typed — a full queue raises
+        ``ServiceOverloadedException`` instead of buffering without
+        bound."""
+        future = VerificationFuture(tenant)
+        req = ServeRequest(
+            data=data,
+            checks=tuple(checks),
+            required_analyzers=tuple(required_analyzers),
+            tenant=tenant,
+            run_policy=(
+                run_policy if run_policy is not None
+                else self.config.run_policy
+            ),
+            future=future,
+        )
+        with self._cv:
+            # a not-yet-started service accepts work (it queues until
+            # start()); only a STOPPED service refuses typed
+            if self._closed:
+                raise ServiceClosedException(
+                    "submit on a stopped VerificationService"
+                )
+            if len(self._pending) >= self.config.max_pending:
+                raise ServiceOverloadedException(
+                    f"{len(self._pending)} requests pending >= "
+                    f"max_pending={self.config.max_pending}"
+                )
+            self._pending.append(req)
+            self._cv.notify_all()
+        return future
+
+    def verify(self, data, checks: Sequence = (), **kw):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(data, checks, **kw).result()
+
+    # -- worker ----------------------------------------------------------
+
+    def _worker(self) -> None:
+        from deequ_tpu.parallel.mesh import use_mesh
+
+        with use_mesh(self._mesh):
+            while True:
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                try:
+                    self._serve_batch(batch)
+                # deequ-lint: ignore[bare-except] -- worker survival backstop: an unexpected per-batch failure rejects that batch's futures typed and the loop continues; a dead worker would strand every future forever
+                except Exception as e:  # noqa: BLE001 — the serving loop
+                    # must outlive any one batch: reject what this batch
+                    # left unresolved and keep draining the queue
+                    wrapped = wrap_if_necessary(e)
+                    for req in batch:
+                        if not req.future.done():
+                            req.future._reject(wrapped)
+                finally:
+                    with self._cv:
+                        self._idle = True
+                        self._cv.notify_all()
+
+    def _take_batch(self) -> Optional[List[ServeRequest]]:
+        """Pop up to ``max_batch`` requests, waiting ``coalesce_window``
+        after the first arrival for co-batchable company."""
+        cfg = self.config
+        with self._cv:
+            while not self._pending:
+                if not self._running:
+                    return None
+                self._idle = True
+                self._cv.notify_all()
+                self._cv.wait(0.1)
+            self._idle = False
+        if cfg.coalesce_window > 0 and cfg.max_batch > 1:
+            deadline = time.monotonic() + cfg.coalesce_window
+            with self._cv:
+                while len(self._pending) < cfg.max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._running:
+                        break
+                    self._cv.wait(left)
+        out: List[ServeRequest] = []
+        with self._cv:
+            while self._pending and len(out) < cfg.max_batch:
+                out.append(self._pending.popleft())
+        return out
+
+    # -- execution -------------------------------------------------------
+
+    def _serve_batch(self, batch: List[ServeRequest]) -> None:
+        alive: List[ServeRequest] = []
+        for req in batch:
+            if req.future._claim():
+                alive.append(req)
+        if not alive:
+            return
+        groups: Dict[Any, List[ServeRequest]] = {}
+        serial: List[ServeRequest] = []
+        for req in alive:
+            try:
+                self._admit(req)
+            # deequ-lint: ignore[bare-except] -- admission failure becomes this one future's typed rejection, never silence
+            except Exception as e:  # noqa: BLE001 — admission failure is
+                # this request's outcome, not the batch's
+                req.future._reject(wrap_if_necessary(e))
+                continue
+            if req.coalescable and not self.tenant_health.is_quarantined(
+                req.tenant
+            ):
+                groups.setdefault(req.key, []).append(req)
+            else:
+                serial.append(req)
+        for key, members in groups.items():
+            try:
+                self._serve_coalesced(members)
+            # deequ-lint: ignore[bare-except] -- group isolation: an unexpected failure (bad run_policy, plan-build bug) rejects THIS group's futures typed; sibling groups of the batch still serve
+            except Exception as e:  # noqa: BLE001 — one group's failure
+                # must not strand its siblings' futures
+                wrapped = wrap_if_necessary(e)
+                for req in members:
+                    if not req.future.done():
+                        req.future._reject(wrapped)
+        for req in serial:
+            try:
+                self._serve_serial(req)
+            # deequ-lint: ignore[bare-except] -- per-request isolation: _serve_serial handles engine failures itself; this catches pre-engine failures (e.g. a run_policy without arm()) as the request's typed rejection
+            except Exception as e:  # noqa: BLE001
+                if not req.future.done():
+                    req.future._reject(wrap_if_necessary(e))
+        self.batches_served += 1
+        self.suites_served += len(alive)
+
+    def _admit(self, req: ServeRequest) -> None:
+        """Fingerprint the request and decide coalescability (schema +
+        analyzer + layout + row-count key; see plan_cache).
+
+        The needed-column set and the predicate MARK set (columns an
+        exact-compare predicate routes onto the wide plane) are
+        properties of the ANALYZER SET alone, discovered by building the
+        ops once — cached per analyzer signature (``_families``) so
+        every later request fingerprints without an op build. Marks are
+        re-applied to each member's columns before layout derivation, so
+        key computation is one deterministic function of (analyzers,
+        schema, data ranges) for first and repeat tenants alike."""
+        from deequ_tpu.ops.scan_engine import _ChunkPacker
+        from deequ_tpu.serve.plan_cache import (
+            PlanKey,
+            build_serve_plan,
+            layout_signature,
+            schema_signature,
+        )
+        from deequ_tpu.verification import _dedup_analyzers
+
+        analyzers = list(req.required_analyzers)
+        for check in req.checks:
+            analyzers.extend(check.required_analyzers())
+        req.analyzers = tuple(_dedup_analyzers(analyzers))
+
+        req.coalescable = False
+        if self._mesh is not None:
+            return  # sharded view: serial path preserves mesh numerics
+        data = req.data
+        if getattr(data, "is_streaming", False):
+            return
+        try:
+            n_rows = int(data.num_rows or 0)
+        except (AttributeError, TypeError):
+            return
+        if n_rows <= 0 or not req.analyzers:
+            return
+
+        family = self._families.get(req.analyzers)
+        if family is None:
+            # first sight of this analyzer set: build its plan from this
+            # request's table — the op build discovers the needed
+            # columns and applies the predicate marks we record. Only
+            # TABLE-INDEPENDENT facts may enter the family cache: a
+            # CLASS-level serial verdict (grouping analyzers,
+            # dictionary-baked predicates) is cached, but a verdict this
+            # particular table caused (missing column -> op/precondition
+            # failures, empty/oversized table) must not poison later
+            # tenants' admissions, and a plan carrying THIS table's
+            # failure records must never be replayed for healthy repeat
+            # tenants — such requests serve serially and the family
+            # waits for a healthy first sighting.
+            plan = build_serve_plan(data, req.analyzers)
+            if plan.serial_class:
+                self._families.put(req.analyzers, ((), (), False))
+                return
+            if (
+                not plan.coalescable
+                or plan.op_failures
+                or plan.precondition_failures
+            ):
+                return  # table-level degeneracy: serial, no family yet
+            marks = tuple(
+                n for n in plan.needed
+                if getattr(data[n], "_exact_compare", False)
+            )
+            self._families.put(req.analyzers, (plan.needed, marks, True))
+            plan.key = PlanKey(
+                schema_sig=schema_signature(data, plan.needed),
+                analyzer_sig=req.analyzers,
+                layout_sig=layout_signature(plan.layout),
+                chunk=n_rows,
+            )
+            self.plan_cache.put(plan)
+            req.key = plan.key
+            req.coalescable = True
+            return
+
+        needed, marks, scan_only = family
+        if not scan_only:
+            return
+        if any(n not in data for n in needed):
+            return  # missing columns: the serial path's precondition
+            # machinery reports them as failure metrics
+        for n in marks:
+            data[n]._exact_compare = True
+        try:
+            packer = _ChunkPacker(
+                {n: data[n] for n in needed},
+                max(n_rows, 1),
+                encode_ingest=self._encode,
+            )
+        # deequ-lint: ignore[bare-except] -- fingerprint probe only: an unpackable column routes the suite to the serial path, which re-raises/reports typed
+        except Exception:  # noqa: BLE001 — unpackable columns: serial path
+            return
+        req.key = PlanKey(
+            schema_sig=schema_signature(data, needed),
+            analyzer_sig=req.analyzers,
+            layout_sig=layout_signature(packer.layout()),
+            chunk=n_rows,
+        )
+        req.packer = packer
+        req.coalescable = True
+
+    def _plan_for(self, req: ServeRequest):
+        from deequ_tpu.serve.plan_cache import build_serve_plan
+
+        plan = self.plan_cache.get(req.key)
+        if plan is not None:
+            return plan
+        plan = build_serve_plan(req.data, req.analyzers, key_hint=req.key)
+        self.plan_cache.put(plan)
+        return plan
+
+    def _serve_coalesced(self, members: List[ServeRequest]) -> None:
+        """One PlanKey group: plan lookup, coalesced execution with
+        tenant-axis bisection on device faults, per-member finalize."""
+        plan = self._plan_for(members[0])
+        if not plan.coalescable:
+            for req in members:
+                self._serve_serial(req)
+            return
+        budgets = {
+            id(req): (req.run_policy.arm() if req.run_policy is not None
+                      else None)
+            for req in members
+        }
+        self._dispatch_slice(plan, members, budgets)
+
+    def _dispatch_slice(
+        self,
+        plan,
+        members: List[ServeRequest],
+        budgets: Dict[int, Any],
+        depth: int = 0,
+    ) -> None:
+        """Run one tenant-axis slice coalesced; on a device fault, charge
+        every member's budget and BISECT (isolation in O(log K));
+        singletons that still fault fall to the serial ladder."""
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.serve.executor import run_coalesced
+
+        try:
+            results = run_coalesced(
+                plan,
+                [req.data for req in members],
+                [str(req.tenant) for req in members],
+                plan_lint=self._lint_mode,
+                attempt=depth,
+                packers=[req.packer for req in members],
+            )
+        except PlanLintError as e:
+            # a static contract violation rejects the PROGRAM — every
+            # member of the packed plan shares it, so each future gets
+            # the typed error (the error-mode contract: raise, never
+            # masquerade as data)
+            for req in members:
+                req.future._reject(e)
+            return
+        except DeviceException as e:
+            survivors: List[ServeRequest] = []
+            for req in members:
+                budget = budgets.get(id(req))
+                if budget is None:
+                    survivors.append(req)
+                    continue
+                try:
+                    budget.charge("coalesce_retry", tenant=req.tenant)
+                    survivors.append(req)
+                except RunBudgetExhaustedException as exhausted:
+                    # THIS member's budget is spent: degrade its slice
+                    # only — the rest of the batch retries without it
+                    self._finalize_budget_exhausted(req, exhausted, budget)
+            if len(survivors) == 0:
+                return
+            if len(survivors) == 1:
+                self._serve_serial(
+                    survivors[0], budget=budgets.get(id(survivors[0])),
+                    after_fault=e,
+                )
+                return
+            SCAN_STATS.record_degradation(
+                "coalesce_bisect",
+                members=len(survivors), depth=depth, error=str(e),
+            )
+            mid = len(survivors) // 2
+            self._dispatch_slice(plan, survivors[:mid], budgets, depth + 1)
+            self._dispatch_slice(plan, survivors[mid:], budgets, depth + 1)
+            return
+        # deequ-lint: ignore[bare-except] -- shared-scan failure becomes failure METRICS for every member (the runner's failure-as-data rule); device faults were already caught typed above
+        except Exception as e:  # noqa: BLE001 — a shared-scan failure maps
+            # onto every member's analyzers (the runner's rule)
+            wrapped = wrap_if_necessary(e)
+            for req in members:
+                self._finalize_scan_failure(req, wrapped)
+            return
+        for req, result_row in zip(members, results):
+            self._finalize_member(
+                req, plan, result_row, budgets.get(id(req))
+            )
+
+    # -- finalization ----------------------------------------------------
+
+    def _finalize_member(self, req, plan, scan_results, budget) -> None:
+        """Scan results -> states -> metrics -> check evaluation ->
+        resolved future (the per-tenant host tail the coalesced dispatch
+        cannot share)."""
+        from deequ_tpu.analyzers.runner import AnalysisRunner, AnalyzerContext
+        from deequ_tpu.verification import VerificationSuite
+
+        try:
+            ctx = AnalyzerContext.empty()
+            for a, exc in plan.precondition_failures.items():
+                ctx.metric_map[a] = a.to_failure_metric(exc)
+            for a, exc in plan.op_failures.items():
+                ctx.metric_map[a] = a.to_failure_metric(exc)
+            ctx = AnalysisRunner._finalize_scanning_analyzers(
+                ctx, plan.scannable, plan.extract_plan, scan_results,
+            )
+            result = VerificationSuite._evaluate(req.checks, ctx)
+            result.scan_stats = {"coalesced": True, "device_fetches": 1}
+            if budget is not None:
+                result.run_budget = budget.snapshot()
+            self.tenant_health.record_success(req.tenant)
+            req.future._resolve(result)
+        # deequ-lint: ignore[bare-except] -- finalize failure becomes this member's typed rejection, never silence
+        except Exception as e:  # noqa: BLE001 — finalize failure is this
+            # member's outcome
+            self._record_tenant_failure(req)
+            req.future._reject(wrap_if_necessary(e))
+
+    def _finalize_scan_failure(self, req, wrapped, count_failure=True) -> None:
+        """Shared-scan failure -> failure metrics for every analyzer of
+        this member (failure-as-data, the runner's shared-scan rule)."""
+        from deequ_tpu.analyzers.runner import AnalyzerContext
+        from deequ_tpu.verification import VerificationSuite
+
+        if count_failure:
+            self._record_tenant_failure(req)
+        ctx = AnalyzerContext(
+            {a: a.to_failure_metric(wrapped) for a in req.analyzers}
+        )
+        result = VerificationSuite._evaluate(req.checks, ctx)
+        result.scan_stats = {"coalesced": False, "failed": str(wrapped)}
+        req.future._resolve(result)
+
+    def _finalize_budget_exhausted(self, req, exhausted, budget) -> None:
+        """Budget exhaustion degrades ONLY this member's slice: typed
+        failure metrics + the ledger under ``degrade``, a typed
+        rejection under ``raise``."""
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+        SCAN_STATS.record_degradation(
+            "tenant_budget_exhausted", tenant=req.tenant,
+            reason=exhausted.reason,
+        )
+        self._record_tenant_failure(req)
+        if not exhausted.degraded:
+            req.future._reject(exhausted)
+            return
+        self._finalize_scan_failure(req, exhausted, count_failure=False)
+        # overwrite the generic telemetry with the ledger
+        if req.future._result is not None and budget is not None:
+            req.future._result.run_budget = budget.snapshot()
+
+    def _record_tenant_failure(self, req) -> None:
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+        if self.tenant_health.record_failure(req.tenant):
+            SCAN_STATS.record_degradation(
+                "tenant_quarantine", tenant=req.tenant,
+                consecutive=self.tenant_health.failures.get(req.tenant),
+            )
+
+    # -- serial path -----------------------------------------------------
+
+    def _serve_serial(
+        self, req: ServeRequest, budget=None, after_fault=None
+    ) -> None:
+        """The ordinary per-tenant engine path (full fault ladder) under
+        this member's budget scope — the coalesced path's singleton
+        fallback and the route for non-coalescable / quarantined
+        suites."""
+        from contextlib import nullcontext
+
+        from deequ_tpu.resilience.governance import run_budget_scope
+        from deequ_tpu.verification import VerificationSuite
+
+        if budget is None and req.run_policy is not None:
+            budget = req.run_policy.arm()
+        try:
+            with (
+                run_budget_scope(budget) if budget is not None
+                else nullcontext()
+            ):
+                result = VerificationSuite.do_verification_run(
+                    req.data,
+                    list(req.checks),
+                    list(req.required_analyzers),
+                    on_device_error=self.config.on_device_error,
+                )
+            result.scan_stats = dict(result.scan_stats or {})
+            result.scan_stats["coalesced"] = False
+            if after_fault is not None:
+                result.scan_stats["isolated_after"] = str(after_fault)
+            if budget is not None:
+                result.run_budget = budget.snapshot()
+            # a run that completed only by exhausting its budget into a
+            # degraded partial result is a tenant FAILURE for health
+            # accounting — "resolved" must not heal a quarantine the
+            # exhaustion itself would deepen
+            if budget is not None and budget.exhausted_reason is not None:
+                self._record_tenant_failure(req)
+            else:
+                self.tenant_health.record_success(req.tenant)
+            req.future._resolve(result)
+        except RunBudgetExhaustedException as e:
+            self._finalize_budget_exhausted(req, e, budget)
+        # deequ-lint: ignore[bare-except] -- serial-path failure becomes this request's typed rejection; run_scan already classified device faults inside
+        except Exception as e:  # noqa: BLE001 — this request's outcome
+            self._record_tenant_failure(req)
+            req.future._reject(wrap_if_necessary(e))
+
+    # -- introspection ---------------------------------------------------
+
+    def pending_count(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def stats(self) -> dict:
+        return {
+            "batches_served": self.batches_served,
+            "suites_served": self.suites_served,
+            "pending": self.pending_count(),
+            "plan_cache_entries": len(self.plan_cache),
+            "quarantined_tenants": sorted(
+                map(str, self.tenant_health.quarantined)
+            ),
+        }

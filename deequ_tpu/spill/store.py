@@ -53,10 +53,9 @@ def resolve_group_budget(data=None, explicit: Optional[int] = None) -> Optional[
     attr = getattr(data, "group_memory_budget", None)
     if attr is not None:
         return int(attr)
-    env = os.environ.get(_ENV_BUDGET)
-    if env:
-        return int(env)
-    return None
+    from deequ_tpu.envcfg import env_value
+
+    return env_value(_ENV_BUDGET)
 
 
 def budget_batch_rows(budget_bytes: int) -> int:
