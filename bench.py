@@ -363,19 +363,12 @@ def measure_plan_lint_overhead(table, analyzers):
     }
 
 
-def measure_governance_overhead(n_rows: int):
-    """Run-governance cost probe (resilience/governance.py): the
-    config-1 shape — several small/medium suites back to back — timed
-    ungoverned vs under an armed RunBudget (wall deadline + attempt
-    cap, both far from binding). The healthy path must charge NOTHING
-    (hard-asserted via ``ScanStats.budget_charges``) and cost <1% of
-    wall: budget resolution is two dict lookups per run, and the
-    remaining-wall watchdog cap is one subtraction per scan attempt.
-    min-of-reps on both sides sees through scheduler noise."""
+def _config1_suites(n_rows: int):
+    """The config-1 probe shape shared by the governance and obs
+    overhead probes: one table, 17 analyzers, a ``run_suites()`` that
+    times 4 back-to-back runs."""
     from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
     from deequ_tpu.analyzers.runner import AnalysisRunner
-    from deequ_tpu.ops.scan_engine import SCAN_STATS
-    from deequ_tpu.resilience.governance import RunPolicy, run_budget_scope
 
     table = build_table(n_rows)
     analyzers = [Size()]
@@ -392,36 +385,180 @@ def measure_governance_overhead(n_rows: int):
         assert all(m.value.is_success for m in ctx.all_metrics())
         return wall
 
+    return run_suites
+
+
+def _stable_overhead_frac(plain_fn, treated_fn, gate: float, what: str):
+    """Overhead measurement hardened for 1-vCPU containers (the
+    measure_governance_overhead flake, pre-round-11): scheduler noise
+    there is BIMODAL — a rep that loses its timeslice mid-run reads as
+    5-10% 'overhead' on either side, so min-of-reps across sides still
+    trips the gate a few runs in a hundred. Discipline now:
+
+    - per TRIAL, 3 interleaved plain/treated pairs; the trial's frac is
+      computed from the MIN wall of each side (a descheduled rep
+      vanishes into the other two; interleaving means drift hits both
+      sides alike — single-pair fracs measured ±10% on this container,
+      far above the 1% gate);
+    - the probe's verdict is the MEDIAN of 5 such trials (a noise burst
+      spanning a whole trial lands in the tail, not the median);
+    - one DISCARD-AND-RETRY pass before the gate fires: a median over
+      the gate re-measures 5 fresh trials once (a burst spanning most
+      of a 5-trial window passes; a real regression fails twice).
+
+    A sustained-load tail remains (a busy container can keep EVERY
+    treated rep 3-5% 'slow' for seconds at a stretch), so the verdict
+    also admits the BEST-OF-ALL-REPS floor: the frac between the
+    fastest treated and fastest plain wall across every rep measured.
+    Noise cannot make that floor large (15+ reps per side see at least
+    one clean window each), while a real regression inflates every
+    treated rep — floor included.
+
+    Returns the winning frac and asserts ``frac < gate``."""
+    all_plain: list = []
+    all_treated: list = []
+
+    def median_frac():
+        fracs = []
+        for _ in range(5):
+            plain = float("inf")
+            treated = float("inf")
+            for _ in range(3):
+                plain = min(plain, plain_fn())
+                treated = min(treated, treated_fn())
+            all_plain.append(plain)
+            all_treated.append(treated)
+            fracs.append(max(treated - plain, 0.0) / max(plain, 1e-9))
+        fracs.sort()
+        return fracs[2], fracs
+
+    def floor_frac():
+        best_plain = min(all_plain)
+        return max(min(all_treated) - best_plain, 0.0) / max(
+            best_plain, 1e-9
+        )
+
+    frac, trials = median_frac()
+    if min(frac, floor_frac()) >= gate:
+        print(
+            f"{what}: median {frac:.4f} >= {gate:g} "
+            f"(trials={['%.4f' % f for f in trials]}) — discarding and "
+            "retrying once (bimodal scheduler noise on small containers)",
+            file=sys.stderr,
+        )
+        retry, trials = median_frac()
+        # the verdict is the BETTER of the two medians: a noise burst
+        # spanning one whole 5-trial window passes on the clean window,
+        # while a real regression measures over the gate in both
+        frac = min(frac, retry)
+    frac = min(frac, floor_frac())
+    assert frac < gate, (
+        f"{what} overhead {frac:.4f} >= {gate:g} of healthy wall after "
+        f"discard-and-retry (trials={['%.4f' % f for f in trials]})"
+    )
+    return frac
+
+
+def measure_governance_overhead(n_rows: int):
+    """Run-governance cost probe (resilience/governance.py): the
+    config-1 shape — several small/medium suites back to back — timed
+    ungoverned vs under an armed RunBudget (wall deadline + attempt
+    cap, both far from binding). The healthy path must charge NOTHING
+    (hard-asserted via ``ScanStats.budget_charges``) and cost <1% of
+    wall: budget resolution is two dict lookups per run, and the
+    remaining-wall watchdog cap is one subtraction per scan attempt.
+    Noise discipline: median-of-5 interleaved trials with one
+    discard-and-retry pass (``_stable_overhead_frac``)."""
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.resilience.governance import RunPolicy, run_budget_scope
+
+    run_suites = _config1_suites(n_rows)
+
     def governed():
         budget = RunPolicy(
             run_deadline=600.0, max_total_attempts=1 << 16
         ).arm()
         with run_budget_scope(budget):
             wall = run_suites()
-        return wall, budget
-
-    run_suites()  # warmup: compile the fused program
-    plain = float("inf")
-    with_budget = float("inf")
-    charges_before = SCAN_STATS.budget_charges
-    for _ in range(5):  # interleaved so drift hits both sides alike
-        plain = min(plain, run_suites())
-        wall, budget = governed()
-        with_budget = min(with_budget, wall)
         assert budget.attempts == 0, (
             f"healthy run charged the budget: {budget.charges}"
         )
+        return wall
+
+    run_suites()  # warmup: compile the fused program
+    charges_before = SCAN_STATS.budget_charges
+    frac = _stable_overhead_frac(
+        run_suites, governed, gate=0.01, what="governance"
+    )
     assert SCAN_STATS.budget_charges == charges_before, (
         "healthy-path scans must not charge the budget ledger"
     )
-    frac = max(with_budget - plain, 0.0) / max(plain, 1e-9)
-    assert frac < 0.01, (
-        f"governance overhead {frac:.4f} >= 1% of healthy wall "
-        f"(plain={plain*1000:.1f}ms governed={with_budget*1000:.1f}ms)"
-    )
     return {
         "governance_overhead_frac": round(frac, 4),
-        "governed_wall_ms": round(with_budget * 1000, 2),
+    }
+
+
+def measure_obs_overhead(n_rows: int):
+    """Observability cost probe (deequ_tpu/obs): the config-1 shape
+    timed DISARMED (no recorder anywhere — the production default) vs
+    ARMED (an ambient FlightRecorder recording every seam span). Two
+    contracts, both hard-asserted:
+
+    - disarmed is FREE: a disarmed run must leave a canary recorder
+      empty and write nothing span-shaped anywhere — the disarmed seam
+      cost is one module-global integer check, which no wall-clock
+      probe on a noisy container can even resolve (that structural
+      zero IS the disarmed assert);
+    - armed costs <1% of healthy wall (median-of-5 trials + one
+      discard-and-retry, the governance probe's harness), while
+      actually recording (span count > 0 re-asserted per trial)."""
+    from deequ_tpu.obs import recorder as _rec_mod
+    from deequ_tpu.obs.recorder import (
+        FlightRecorder,
+        current_recorder,
+        maybe_arm_from_env,
+        recording_scope,
+    )
+
+    run_suites = _config1_suites(n_rows)
+
+    # disarmed-is-free (structural): nothing is armed anywhere — not
+    # here, and not as a side effect of running. Arm from the env
+    # FIRST: the global recorder is created lazily, so a bench
+    # environment leaking DEEQU_TPU_TRACE=1 would otherwise pass the
+    # disarmed assert and then arm itself during warmup, turning the
+    # A/B into armed-vs-armed.
+    maybe_arm_from_env()
+    assert current_recorder() is None, (
+        "obs probe must start disarmed (a leaked recording_scope or "
+        "DEEQU_TPU_TRACE in the bench environment?)"
+    )
+    run_suites()  # warmup: compile the fused program
+    # the disarmed run must leave the process structurally disarmed:
+    # the module armed-counter at zero (every seam's fast path is one
+    # read of it) and no global recorder installed as a side effect
+    assert _rec_mod._armed == 0 and _rec_mod.global_recorder() is None, (
+        "a disarmed run armed the flight recorder as a side effect"
+    )
+
+    def armed():
+        # a fresh bounded recorder per trial: steady-state armed cost,
+        # not the cost of an ever-growing ring
+        rec = FlightRecorder(capacity=1 << 14)
+        with recording_scope(rec):
+            wall = run_suites()
+        assert len(rec) > 0, "armed run recorded no spans"
+        return wall
+
+    frac = _stable_overhead_frac(
+        run_suites, armed, gate=0.01, what="obs tracing"
+    )
+    assert _rec_mod._armed == 0 and _rec_mod.global_recorder() is None, (
+        "the armed trials leaked arming past their scopes"
+    )
+    return {
+        "obs_overhead_frac": round(frac, 4),
+        "obs_disarmed_armed_counter": _rec_mod._armed,
     }
 
 
@@ -578,6 +715,12 @@ def measure_serving_load(n_tenants: int, rows_per_tenant: int = 256):
     from deequ_tpu.ops.scan_engine import SCAN_STATS
     from deequ_tpu.parallel.mesh import use_mesh
     from deequ_tpu.serve import VerificationService
+
+    from deequ_tpu.obs.registry import SERVE_LATENCY
+
+    # clean histogram window: the emitted p50/p95/p99 snapshot covers
+    # THIS probe's submissions (the registry instrument is process-wide)
+    SERVE_LATENCY.reset()
 
     rng = np.random.default_rng(17)
     REPEAT_SHAPES = 8  # distinct suite shapes shared by repeat tenants
@@ -779,8 +922,26 @@ def measure_serving_load(n_tenants: int, rows_per_tenant: int = 256):
     cold_misses = (
         cold_after["plan_cache_misses"] - cold_before["plan_cache_misses"]
     )
+    # the unified registry's serving latency histogram (obs/registry,
+    # round 11): the per-tenant submit->resolve distribution the service
+    # feeds ALWAYS-ON — run_configs --config 6 banks these quantiles
+    # next to the futures-derived p50/p99 above (the two views must
+    # agree; tier-1 test_obs pins it)
+    hist = SERVE_LATENCY.aggregate.snapshot()
+    # live vs evicted label histograms reported separately: their SUM
+    # counts label-(re)creation events, not distinct tenants (a tenant
+    # re-observed after an LRU eviction creates a fresh label)
+    latency_hist = {
+        "count": hist["count"],
+        "p50_ms": round((hist["p50"] or 0.0) * 1000, 2),
+        "p95_ms": round((hist["p95"] or 0.0) * 1000, 2),
+        "p99_ms": round((hist["p99"] or 0.0) * 1000, 2),
+        "labels_live": len(SERVE_LATENCY.labels()),
+        "labels_evicted": SERVE_LATENCY.evicted_labels,
+    }
     return {
         "serving_suites_per_sec": round(suites_persec, 1),
+        "serving_latency_hist": latency_hist,
         "serving_cold_suites_per_sec": round(
             n_tenants / max(cold_wall, 1e-9), 1
         ),
@@ -936,6 +1097,11 @@ def main():
         SMOKE_ROWS if smoke else 200_000
     )
     print(f"governance probe: {governance_probe}", file=sys.stderr)
+    # observability probe (round 11): armed-vs-disarmed flight-recorder
+    # A/B on the same config-1 shape — <1% armed, structurally zero
+    # disarmed (asserted inside)
+    obs_probe = measure_obs_overhead(SMOKE_ROWS if smoke else 200_000)
+    print(f"obs probe: {obs_probe}", file=sys.stderr)
     # serving-layer probe (round 10): the 1k-tenant open-loop load with
     # the bit-identity / zero-trace / one-fetch-per-batch / >=5x gates
     # asserted inside
@@ -943,7 +1109,8 @@ def main():
     print(f"serving probe: {serving_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
-        **lint_probe, **ingest_probe, **governance_probe, **serving_probe,
+        **lint_probe, **ingest_probe, **governance_probe, **obs_probe,
+        **serving_probe,
     }
 
     if smoke:

@@ -89,19 +89,48 @@ __version__ = "0.1.0"
 
 
 def execution_report() -> dict:
-    """Engine execution report: fused scan passes, grouping/KLL passes,
-    rows/bytes scanned, and scan wall time since the last reset. The
-    first-class analogue of the reference's test-only SparkMonitor job
-    accounting (SURVEY.md §5)."""
+    """Engine execution report — the UNIFIED obs-registry snapshot
+    (deequ_tpu/obs/registry; round 11): one call scrapes the whole
+    engine. Sections: ``"scan"`` (the ScanStats counters — fused
+    passes, rows/bytes, fault-ladder telemetry), ``"retry"``
+    (RETRY_TELEMETRY), ``"hbm"`` (device-residency ledger), ``"serve"``
+    (queue depth, per-tenant latency histograms, coalesce occupancy),
+    ``"env"`` (the DEEQU_TPU_* configuration this process runs under),
+    and ``"instruments"`` (the registry's owned
+    counters/gauges/histograms). The first-class analogue of the
+    reference's test-only SparkMonitor job accounting (SURVEY.md §5).
+
+    The pre-round-11 flat ScanStats shape stays available as
+    :func:`scan_execution_report` (a deprecation-free alias — it IS the
+    ``"scan"`` section)."""
+    from deequ_tpu.obs.registry import REGISTRY
+
+    return REGISTRY.snapshot()
+
+
+def scan_execution_report() -> dict:
+    """The flat ``ScanStats`` dict ``execution_report()`` returned
+    before round 11 — kept as a first-class alias (no deprecation):
+    identical to ``execution_report()["scan"]``."""
     from deequ_tpu.ops.scan_engine import SCAN_STATS
 
     return SCAN_STATS.snapshot()
 
 
+def execution_report_text() -> str:
+    """Prometheus-style text exposition of the unified registry — the
+    scrape endpoint payload for online monitoring (ROADMAP item 5)."""
+    from deequ_tpu.obs.registry import REGISTRY
+
+    return REGISTRY.render_text()
+
+
 def reset_execution_report() -> None:
+    from deequ_tpu.obs.registry import REGISTRY
     from deequ_tpu.ops.scan_engine import SCAN_STATS
 
     SCAN_STATS.reset()
+    REGISTRY.reset_instruments()
 
 __all__ = [
     "Check",

@@ -233,3 +233,11 @@ SERVE_COALESCE_WINDOW = register(EnvVar(
     minimum=0.0,
     doc="seconds the serve worker waits for co-batchable submissions",
 ))
+TRACE = register(EnvVar(
+    "DEEQU_TPU_TRACE", "flag01", default=False,
+    doc="1 arms the process-global flight recorder (deequ_tpu/obs)",
+))
+TRACE_CAPACITY = register(EnvVar(
+    "DEEQU_TPU_TRACE_CAPACITY", "int", default=None, minimum=1,
+    doc="ring-buffer capacity (records) of the env-armed flight recorder",
+))

@@ -43,6 +43,15 @@ Rules (stable ids; all severity "error" — the repo pass is a CI gate):
   ``deequ_tpu.exceptions`` taxonomy (or a precise builtin like
   ``ValueError`` for argument validation), never the generic classes the
   fault ladder cannot dispatch on.
+- ``span-in-jit`` — flight-recorder emission (``<recorder>.span(...)``
+  / ``.event(...)`` / ``.record_span(...)``, ``current_recorder()``,
+  ``recording_scope(...)``) inside a function that is jitted or traced
+  (the same traced-function set ``jit-impure`` computes): a span
+  emitted from traced code is a host callback by another name — it
+  bakes one trace-time record into the cached program and re-fires (or
+  worse, doesn't) on every replay, exactly the ``jit-impure`` failure
+  class. Spans belong at the HOST seams around the program
+  (``device_call``, the packing loops), never inside it.
 - ``suppress-reason`` — a ``# deequ-lint: ignore[rule]`` suppression
   without a reason. Suppressions are triage records; a bare one is a
   finding itself AND grants no suppression (the underlying finding
@@ -71,11 +80,16 @@ from deequ_tpu.lint.findings import LintFinding
 RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # serve/ is device-adjacent (round 10): its coalesced executor
     # fetches and its worker loop wraps device seams, so the host-fetch
-    # accounting and typed-raise disciplines apply there in full
-    "host-fetch": ("ops/", "parallel/", "anomaly/", "serve/"),
-    "bare-except": ("ops/", "parallel/", "resilience/", "serve/"),
+    # accounting and typed-raise disciplines apply there in full.
+    # obs/ joins the same three scopes in round 11: the flight recorder
+    # sits beside every device seam, and an accidental fetch or
+    # swallowed fault in the observability layer would be the least
+    # observable bug of all.
+    "host-fetch": ("ops/", "parallel/", "anomaly/", "serve/", "obs/"),
+    "bare-except": ("ops/", "parallel/", "resilience/", "serve/", "obs/"),
     "jit-impure": ("",),
-    "typed-raise": ("ops/", "resilience/", "serve/"),
+    "typed-raise": ("ops/", "resilience/", "serve/", "obs/"),
+    "span-in-jit": ("",),
     "suppress-reason": ("",),
 }
 
@@ -143,6 +157,29 @@ def _is_tracing_ref(parts: List[str]) -> bool:
     return len(parts) == 1 or parts[0] in _TRACING_BASES
 
 _GENERIC_RAISES = frozenset(("Exception", "RuntimeError", "BaseException"))
+
+#: flight-recorder emission shapes for the span-in-jit rule: attribute
+#: calls any recorder object exposes (``rec.span`` / ``.event`` /
+#: ``.record_span``) and the ambient-arming module functions. Like
+#: host-fetch, a convention checker over names — an unrelated
+#: ``.event()`` method on another object inside traced code would
+#: false-positive and takes a per-line annotated ignore.
+_SPAN_EMIT_ATTRS = frozenset(("span", "event", "record_span"))
+_SPAN_EMIT_FNS = frozenset(
+    ("current_recorder", "recording_scope", "maybe_arm_from_env")
+)
+
+
+def _span_emission(parts: List[str]) -> Optional[str]:
+    """A human label when the dotted call is a flight-recorder emission
+    shape, else None."""
+    if not parts:
+        return None
+    if parts[-1] in _SPAN_EMIT_FNS:
+        return f"{parts[-1]}(...)"
+    if len(parts) > 1 and parts[-1] in _SPAN_EMIT_ATTRS:
+        return f"<recorder>.{parts[-1]}(...)"
+    return None
 
 _SUPPRESS_RE = re.compile(
     r"#\s*deequ-lint:\s*ignore\[([a-z0-9_,\s-]+)\]\s*(?:(?:--|—)\s*(\S.*))?"
@@ -489,9 +526,11 @@ def lint_source(
                 "with a reason)",
             )
 
-    # -- jit-impure ------------------------------------------------------
-    if in_scope("jit-impure"):
+    # -- jit-impure / span-in-jit ---------------------------------------
+    traced: Set[str] = set()
+    if in_scope("jit-impure") or in_scope("span-in-jit"):
         traced = _traced_function_names(tree)
+    if in_scope("jit-impure"):
         for node in ast.walk(tree):
             if (
                 isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
@@ -509,6 +548,30 @@ def lint_source(
                         f"{kind} call inside traced function "
                         f"'{node.name}': the value is baked at trace "
                         "time and replayed from the program cache",
+                    )
+
+    # -- span-in-jit -----------------------------------------------------
+    if in_scope("span-in-jit"):
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in traced
+            ):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    what = _span_emission(_dotted(sub.func))
+                    if what is None:
+                        continue
+                    add(
+                        "span-in-jit",
+                        sub,
+                        f"{what} inside traced function '{node.name}': "
+                        "span/event emission in jitted code is a host "
+                        "callback by another name — it bakes a "
+                        "trace-time record into the cached program "
+                        "(emit at the host seams around the dispatch "
+                        "instead)",
                     )
 
     # -- typed-raise -----------------------------------------------------

@@ -1,0 +1,58 @@
+"""deequ_tpu.obs — run flight recorder + unified telemetry.
+
+Three pieces (docs/observability.md):
+
+- :mod:`~deequ_tpu.obs.recorder` — typed, monotonic-clock span/event
+  records at every engine seam; ring-buffer bounded; OFF by default and
+  armed via ``run_scan(trace=...)`` /
+  ``VerificationRunBuilder.with_tracing()`` / ``DEEQU_TPU_TRACE=1``;
+- :mod:`~deequ_tpu.obs.export` — Chrome-trace/Perfetto JSON export of a
+  recording (one track per thread, nested spans, instant events for
+  fault rungs and budget charges);
+- :mod:`~deequ_tpu.obs.registry` — the unified metrics registry:
+  counters/gauges/histograms plus read-through collectors over the
+  existing singletons (``ScanStats``, ``RETRY_TELEMETRY``, HBM ledger,
+  envcfg, the serving layer's latency histograms), scraped whole by
+  ``deequ_tpu.execution_report()``.
+"""
+
+from deequ_tpu.obs.export import to_chrome_trace, write_chrome_trace
+from deequ_tpu.obs.recorder import (
+    DEFAULT_CAPACITY,
+    FlightRecorder,
+    SpanRecord,
+    current_recorder,
+    global_recorder,
+    install_global_recorder,
+    maybe_arm_from_env,
+    recording_scope,
+    resolve_recorder,
+)
+from deequ_tpu.obs.registry import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    HistogramFamily,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "FlightRecorder",
+    "SpanRecord",
+    "current_recorder",
+    "global_recorder",
+    "install_global_recorder",
+    "maybe_arm_from_env",
+    "recording_scope",
+    "resolve_recorder",
+    "to_chrome_trace",
+    "write_chrome_trace",
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "HistogramFamily",
+    "MetricsRegistry",
+]

@@ -41,6 +41,7 @@ from deequ_tpu.exceptions import (
     DeviceHangException,
     classify_device_error,
 )
+from deequ_tpu.obs.recorder import current_recorder
 
 # -- fault-injection seam ----------------------------------------------------
 
@@ -205,19 +206,31 @@ def device_call(
             hook(boundary, hook_ctx)
         return fn()
 
-    try:
-        if deadline is not None:
-            return _call_with_deadline(body, deadline, what, boundary)
-        return body()
-    except DeviceException:
-        raise
-    except Exception as e:  # noqa: BLE001 — classified below; non-device
-        # errors (logic bugs, KeyboardInterrupt is not an Exception)
-        # propagate exactly as before
-        typed = classify_device_error(e, boundary)
-        if typed is not None:
-            raise typed from e
-        raise
+    def classified():
+        try:
+            if deadline is not None:
+                return _call_with_deadline(body, deadline, what, boundary)
+            return body()
+        except DeviceException:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below;
+            # non-device errors (logic bugs; KeyboardInterrupt is not an
+            # Exception) propagate exactly as before
+            typed = classify_device_error(e, boundary)
+            if typed is not None:
+                raise typed from e
+            raise
+
+    # flight-recorder seam (deequ_tpu/obs): every device boundary is a
+    # span when a recorder is armed — the span opens on the CALLER
+    # thread (its track), wrapping the watchdog wait too, so a hang
+    # shows as a long span ending in a typed error. Disarmed cost: one
+    # module-global integer check.
+    rec = current_recorder()
+    if rec is not None:
+        with rec.span(boundary, what=what):
+            return classified()
+    return classified()
 
 
 # -- backend health ----------------------------------------------------------

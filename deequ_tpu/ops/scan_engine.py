@@ -31,6 +31,7 @@ import itertools
 import math
 import os
 import weakref
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -48,6 +49,12 @@ from deequ_tpu.exceptions import (
     classify_device_error,
 )
 from deequ_tpu.expr.eval import Val
+from deequ_tpu.obs.recorder import (
+    current_recorder,
+    maybe_arm_from_env,
+    recording_scope,
+    resolve_recorder,
+)
 from deequ_tpu.ops.device_policy import (
     DEVICE_HEALTH,
     MESH_HEALTH,
@@ -404,9 +411,22 @@ class ScanStats:
     def record_degradation(self, kind: str, **detail) -> dict:
         """Append one degradation decision (kind: 'oom_bisect' |
         'cpu_fallback' | 'watchdog_timeout' | 'device_fault') for
-        execution reports and VerificationResult.device_events."""
+        execution reports and VerificationResult.device_events.
+
+        This is also the flight recorder's fault-ladder seam: EVERY
+        rung of every ladder (oom_bisect, encoded_demote, mesh_reshard,
+        cpu_fallback, coalesce_bisect, tenant_quarantine, ...) reports
+        here, so one instant-event emission covers them all — inside
+        the attempt span when the rung fires within one, parentless
+        otherwise."""
         event = {"kind": kind, **detail}
         self.degradation_events.append(event)
+        rec = current_recorder()
+        if rec is not None:
+            rec.event(kind, **{
+                k: v for k, v in detail.items()
+                if isinstance(v, (int, float, str, bool, type(None)))
+            })
         return event
 
     def effective_bytes_per_sec(self) -> float:
@@ -1714,28 +1734,34 @@ def _maybe_plan_lint(
         return
     from deequ_tpu.lint.plan_lint import enforce_plan_lint, lint_plan_cached
 
-    avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
-    memo_key = None
-    baked = any(op.dictionary_baked for op in plan_ir.ops)
-    if prog_key is not None and not baked:
-        global_key = _global_prog_key(prog_key, packer, mesh)
-        if global_key is not None:
-            memo_key = (
-                global_key,
-                plan_ir.variant,
-                plan_ir.ingest_variant,
-                plan_ir.encoded_columns,
-                plan_ir.fold_tags,
-                bool(fallback),
-            )
-    findings, traced = lint_plan_cached(
-        plan_ir, lambda *a: raw_flat(*a, lut_arrays), avals, memo_key
-    )
-    if traced:
-        SCAN_STATS.plan_lint_traces += 1
-    if findings:
-        SCAN_STATS.plan_lints.extend(f.as_dict() for f in findings)
-    enforce_plan_lint(findings, mode)
+    rec = current_recorder()
+    with (
+        rec.span("plan_lint", variant=plan_ir.variant, mode=mode)
+        if rec is not None
+        else nullcontext()
+    ):
+        avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+        memo_key = None
+        baked = any(op.dictionary_baked for op in plan_ir.ops)
+        if prog_key is not None and not baked:
+            global_key = _global_prog_key(prog_key, packer, mesh)
+            if global_key is not None:
+                memo_key = (
+                    global_key,
+                    plan_ir.variant,
+                    plan_ir.ingest_variant,
+                    plan_ir.encoded_columns,
+                    plan_ir.fold_tags,
+                    bool(fallback),
+                )
+        findings, traced = lint_plan_cached(
+            plan_ir, lambda *a: raw_flat(*a, lut_arrays), avals, memo_key
+        )
+        if traced:
+            SCAN_STATS.plan_lint_traces += 1
+        if findings:
+            SCAN_STATS.plan_lints.extend(f.as_dict() for f in findings)
+        enforce_plan_lint(findings, mode)
 
 
 def _block_throttle(arr) -> None:
@@ -1811,8 +1837,18 @@ def _governed_attempt(budget, fn: Callable, what: str):
         return fn()
     from deequ_tpu.resilience.governance import run_budget_scope
 
+    # both ambient slots are thread-local; the watchdog worker re-enters
+    # them (budget: so charge sites keep drawing on this run's ledger;
+    # recorder: so the attempt's seam spans keep recording, parented to
+    # the caller's current span)
+    rec = current_recorder()
+    rec_parent = rec.current_span_id() if rec is not None else None
+
     def governed_fn():
         with run_budget_scope(budget):
+            if rec is not None:
+                with recording_scope(rec, rec_parent):
+                    return fn()
             return fn()
 
     return _call_with_deadline(
@@ -1837,6 +1873,7 @@ def run_scan(
     run_deadline: Optional[float] = None,
     max_total_attempts: Optional[int] = None,
     on_budget_exhausted: Optional[str] = None,
+    trace=None,
 ) -> List[Any]:
     """Run all ops in ONE fused device pass over the table (in-memory,
     device-resident, or streaming).
@@ -1956,6 +1993,29 @@ def run_scan(
             f"on_device_error must be 'fail' or 'fallback', "
             f"got {on_device_error!r}"
         )
+    # flight recorder (deequ_tpu/obs): an explicit trace argument scopes
+    # a recorder (True = the env-armed global, else a call-scoped
+    # anonymous one; False suppresses) for this whole scan, every
+    # ladder attempt included — then re-enters so every seam below
+    # resolves it ambiently. trace=None defers to the ambient scope /
+    # the DEEQU_TPU_TRACE-armed global. Nothing here installs
+    # process-wide state: one traced call must not leave later runs
+    # armed.
+    maybe_arm_from_env()
+    if trace is not None:
+        with recording_scope(resolve_recorder(trace)):
+            return run_scan(
+                table, ops,
+                chunk_rows=chunk_rows, mesh=mesh, defer=defer,
+                on_device_error=on_device_error,
+                device_deadline=device_deadline, window=window,
+                shard_deadline=shard_deadline,
+                select_kernel=select_kernel, plan_lint=plan_lint,
+                encoded_ingest=encoded_ingest,
+                run_deadline=run_deadline,
+                max_total_attempts=max_total_attempts,
+                on_budget_exhausted=on_budget_exhausted,
+            )
     budget = current_run_budget()
     if budget is None:
         run_policy = resolve_run_policy(
@@ -1989,6 +2049,7 @@ def run_scan(
         shard_deadline = default_shard_deadline()
     window = _resolve_scan_window(window)
     scan_id = next(_SCAN_IDS)
+    rec = current_recorder()
     if getattr(table, "is_streaming", False):
         if defer:
             raise ValueError(
@@ -2012,17 +2073,24 @@ def run_scan(
         # with one attempt-level watchdog (one worker thread per governed
         # scan, not per device call — the <1% healthy-path contract): a
         # hung dispatch becomes a typed DeviceHangException inside
-        # run_deadline
-        return _governed_attempt(
-            budget,
-            lambda: _run_scan_stream(
-                table, ops, chunk_rows, mesh,
-                scan_id=scan_id, device_deadline=stream_deadline,
-                window=window, select_kernel=select_kernel,
-                plan_lint=plan_lint, encoded=encoded_ingest,
-            ),
-            f"stream scan {scan_id} (run budget)",
-        )
+        # run_deadline. A whole stream scan is ONE attempt span (streams
+        # never retry in here — see _run_scan_stream's budget audit).
+        with (
+            rec.span("scan_attempt", scan_id=scan_id, attempt=0,
+                     stream=True)
+            if rec is not None
+            else nullcontext()
+        ):
+            return _governed_attempt(
+                budget,
+                lambda: _run_scan_stream(
+                    table, ops, chunk_rows, mesh,
+                    scan_id=scan_id, device_deadline=stream_deadline,
+                    window=window, select_kernel=select_kernel,
+                    plan_lint=plan_lint, encoded=encoded_ingest,
+                ),
+                f"stream scan {scan_id} (run budget)",
+            )
 
     chunk_override = chunk_rows
     attempt = 0
@@ -2068,224 +2136,236 @@ def run_scan(
         )
     depth = 0
     while True:
-        n_dev = _mesh_size(mesh)
-        floor = max(n_dev, min(MIN_BISECT_CHUNK_ROWS, max(table.num_rows, 1)))
-        # straggler watchdog: on a MULTI-chip dispatch the per-shard
-        # deadline bounds how long one stalled chip may hold a collective
-        straggler_armed = shard_deadline is not None and n_dev > 1
-        attempt_deadline = device_deadline
-        if straggler_armed:
-            attempt_deadline = (
-                shard_deadline
-                if device_deadline is None
-                else min(device_deadline, shard_deadline)
+        # one span per ladder attempt: the seam spans (transfer/
+        # trace/execute/fetch via device_call) nest under it, and a
+        # rung firing in the except blocks below records its instant
+        # event INSIDE the attempt span it degraded
+        with (
+            rec.span(
+                "scan_attempt", scan_id=scan_id, attempt=attempt,
+                fallback=fallback,
             )
-        scan_ctx = {
-            "scan_id": scan_id, "attempt": attempt, "fallback": fallback,
-            "device_ids": mesh_device_ids(mesh),
-        }
-        report: Dict[str, Any] = {}
-
-        def _reshard_after(e: DeviceException) -> bool:
-            """Shrink the mesh around the chip(s) ``e`` implicates; True
-            when a healthy accelerator subset remains and the scan should
-            re-dispatch on it."""
-            nonlocal mesh, chunk_override, depth
-            mesh_ids = set(mesh_device_ids(mesh))
-            lost = [
-                d for d in getattr(e, "device_ids", ()) if d in mesh_ids
-            ]
-            if not lost or len(mesh_ids) <= 1:
-                return False
-            SCAN_STATS.mesh_faults += 1
-            MESH_HEALTH.record_fault(e)
-            new_mesh = mesh_excluding(
-                mesh, set(lost) | set(MESH_HEALTH.quarantined())
-            )
-            if new_mesh is None:
-                return False
-            # residency is pinned (sharded) onto the OLD mesh — including
-            # the dead chip(s); it cannot serve the shrunken mesh
-            freed = _evict_device_cache(table)
-            SCAN_STATS.mesh_reshards += 1
-            SCAN_STATS.record_degradation(
-                "mesh_reshard", scan_id=scan_id,
-                lost_devices=sorted(lost),
-                mesh_from=len(mesh_ids), mesh_to=_mesh_size(new_mesh),
-                evicted_bytes=freed, error=str(e),
-            )
-            mesh = new_mesh
-            # the pressure that drove any bisection left with the chip:
-            # restart at the caller's chunk size, or a per-chip OOM that
-            # bottomed out at the ~64-row floor would pin the WHOLE rest
-            # of the scan at floor-sized dispatches on a healthy mesh (a
-            # recurring OOM on the survivors simply re-bisects)
-            chunk_override = chunk_rows
-            depth = 0
-            return True
-
-        try:
-            if fallback:
-                SCAN_STATS.fallback_scans += 1
-                SCAN_STATS.fallback_backend = "cpu"
-                # the resident chunks (and on single-device setups even a
-                # mesh=None cache) are committed to the ACCELERATOR —
-                # jax.default_device cannot move committed arrays, so the
-                # fallback must drop residency or it would dispatch right
-                # back onto the device it is fleeing
-                _evict_device_cache(table)
-
-                def _fallback_once():
-                    # jax.default_device is THREAD-LOCAL: the context
-                    # must open inside the (possibly watchdog-worker)
-                    # thread that runs the attempt. The per-call
-                    # watchdog stays disarmed here — it exists to detect
-                    # a hung ACCELERATOR, and the CPU re-jit
-                    # legitimately pays a fresh compile — but the run
-                    # budget's attempt-level watchdog still bounds the
-                    # whole rung, so termination within run_deadline
-                    # covers the fallback too
-                    with jax.default_device(_cpu_fallback_device()):
-                        return _run_scan_once(
-                            table, ops, chunk_override, None, defer,
-                            None, scan_ctx, report, window,
-                            select_kernel=select_kernel,
-                            plan_lint=plan_lint,
-                            encoded=encoded_ingest,
-                        )
-
-                return _governed_attempt(
-                    budget, _fallback_once,
-                    f"scan {scan_id} CPU fallback (run budget)",
+            if rec is not None
+            else nullcontext()
+        ):
+            n_dev = _mesh_size(mesh)
+            floor = max(n_dev, min(MIN_BISECT_CHUNK_ROWS, max(table.num_rows, 1)))
+            # straggler watchdog: on a MULTI-chip dispatch the per-shard
+            # deadline bounds how long one stalled chip may hold a collective
+            straggler_armed = shard_deadline is not None and n_dev > 1
+            attempt_deadline = device_deadline
+            if straggler_armed:
+                attempt_deadline = (
+                    shard_deadline
+                    if device_deadline is None
+                    else min(device_deadline, shard_deadline)
                 )
-            result = _governed_attempt(
-                budget,
-                lambda: _run_scan_once(
-                    table, ops, chunk_override, mesh, defer,
-                    attempt_deadline, scan_ctx, report, window,
-                    select_kernel=select_kernel, plan_lint=plan_lint,
-                    encoded=encoded_ingest,
-                ),
-                f"scan {scan_id} attempt {attempt} (run budget)",
-            )
-            DEVICE_HEALTH.record_success()
-            if n_dev > 1:
-                MESH_HEALTH.record_success(mesh_device_ids(mesh))
-            return result
-        except DeviceOOMException as e:
-            SCAN_STATS.device_faults += 1
-            if not fallback:  # CPU-side faults are not accelerator health
-                DEVICE_HEALTH.record_fault(e)
-            used = report.get("chunk") or chunk_override or DEFAULT_CHUNK_ROWS
-            freed = _evict_device_cache(table)
-            # encoded -> decoded demotion FIRST, like the PR-6
-            # selection -> sort re-plan: the encoded attempt's decode
-            # gathers/dictionary LUTs are the allocations the fault
-            # implicates that the decoded program simply doesn't have —
-            # retry on the known-good decoded path at the same chunk
-            # size; a recurring OOM there bisects as before
-            if not fallback and encoded_ingest and report.get("encoded"):
-                # every ladder retry charges the run budget FIRST: an
-                # exhausted budget raises typed here instead of spending
-                # another rung (the charge exception carries the ledger)
-                if budget is not None:
-                    budget.charge("encoded_demote", scan_id=scan_id)
-                encoded_ingest = False
-                SCAN_STATS.encoded_demotions += 1
+            scan_ctx = {
+                "scan_id": scan_id, "attempt": attempt, "fallback": fallback,
+                "device_ids": mesh_device_ids(mesh),
+            }
+            report: Dict[str, Any] = {}
+
+            def _reshard_after(e: DeviceException) -> bool:
+                """Shrink the mesh around the chip(s) ``e`` implicates; True
+                when a healthy accelerator subset remains and the scan should
+                re-dispatch on it."""
+                nonlocal mesh, chunk_override, depth
+                mesh_ids = set(mesh_device_ids(mesh))
+                lost = [
+                    d for d in getattr(e, "device_ids", ()) if d in mesh_ids
+                ]
+                if not lost or len(mesh_ids) <= 1:
+                    return False
+                SCAN_STATS.mesh_faults += 1
+                MESH_HEALTH.record_fault(e)
+                new_mesh = mesh_excluding(
+                    mesh, set(lost) | set(MESH_HEALTH.quarantined())
+                )
+                if new_mesh is None:
+                    return False
+                # residency is pinned (sharded) onto the OLD mesh — including
+                # the dead chip(s); it cannot serve the shrunken mesh
+                freed = _evict_device_cache(table)
+                SCAN_STATS.mesh_reshards += 1
                 SCAN_STATS.record_degradation(
-                    "encoded_demote", scan_id=scan_id, chunk=int(used),
+                    "mesh_reshard", scan_id=scan_id,
+                    lost_devices=sorted(lost),
+                    mesh_from=len(mesh_ids), mesh_to=_mesh_size(new_mesh),
                     evicted_bytes=freed, error=str(e),
                 )
-                attempt += 1
-                continue
-            halved = max(floor, used // 2)
-            halved = max(n_dev, (halved // n_dev) * n_dev)
-            if halved < used and not fallback:
-                if budget is not None:
-                    budget.charge("oom_bisect", scan_id=scan_id)
-                depth += 1
-                SCAN_STATS.oom_bisections += 1
-                SCAN_STATS.bisection_depth = max(
-                    SCAN_STATS.bisection_depth, depth
-                )
-                SCAN_STATS.record_degradation(
-                    "oom_bisect", scan_id=scan_id, chunk_from=int(used),
-                    chunk_to=int(halved), depth=depth, evicted_bytes=freed,
-                    error=str(e),
-                )
-                chunk_override = halved
-                attempt += 1
-                continue
-            # at the bisection floor: a per-CHIP OOM (the message named
-            # its device) can still shed the sick member and retry on the
-            # healthy remainder before any CPU fallback
-            if not fallback and _reshard_after(e):
-                if budget is not None:
-                    budget.charge("mesh_reshard", scan_id=scan_id)
-                attempt += 1
-                continue
-            # bisection and resharding cannot help any further
-            if can_fallback and not fallback:
-                if budget is not None:
-                    budget.charge("cpu_fallback", scan_id=scan_id)
-                fallback = True
-                attempt += 1
-                SCAN_STATS.record_degradation(
-                    "cpu_fallback", scan_id=scan_id,
-                    reason="oom_at_bisection_floor", chunk=int(used),
-                    error=str(e),
-                )
-                continue
-            raise
-        except DeviceException as e:
-            SCAN_STATS.device_faults += 1
-            if isinstance(e, DeviceHangException):
-                SCAN_STATS.watchdog_timeouts += 1
-                # a hang on a multi-chip dispatch is a straggling
-                # collective only when the PER-SHARD deadline was the one
-                # that bound (attempt_deadline = min of the two): a hang
-                # tripping a tighter device_deadline is a general watchdog
-                # timeout and must not be mislabeled as a straggler
-                if straggler_armed and (
-                    device_deadline is None
-                    or shard_deadline <= device_deadline
-                ):
-                    SCAN_STATS.mesh_stragglers += 1
-                    SCAN_STATS.record_degradation(
-                        "mesh_straggler", scan_id=scan_id,
-                        deadline=e.deadline, mesh_size=n_dev, error=str(e),
+                mesh = new_mesh
+                # the pressure that drove any bisection left with the chip:
+                # restart at the caller's chunk size, or a per-chip OOM that
+                # bottomed out at the ~64-row floor would pin the WHOLE rest
+                # of the scan at floor-sized dispatches on a healthy mesh (a
+                # recurring OOM on the survivors simply re-bisects)
+                chunk_override = chunk_rows
+                depth = 0
+                return True
+
+            try:
+                if fallback:
+                    SCAN_STATS.fallback_scans += 1
+                    SCAN_STATS.fallback_backend = "cpu"
+                    # the resident chunks (and on single-device setups even a
+                    # mesh=None cache) are committed to the ACCELERATOR —
+                    # jax.default_device cannot move committed arrays, so the
+                    # fallback must drop residency or it would dispatch right
+                    # back onto the device it is fleeing
+                    _evict_device_cache(table)
+
+                    def _fallback_once():
+                        # jax.default_device is THREAD-LOCAL: the context
+                        # must open inside the (possibly watchdog-worker)
+                        # thread that runs the attempt. The per-call
+                        # watchdog stays disarmed here — it exists to detect
+                        # a hung ACCELERATOR, and the CPU re-jit
+                        # legitimately pays a fresh compile — but the run
+                        # budget's attempt-level watchdog still bounds the
+                        # whole rung, so termination within run_deadline
+                        # covers the fallback too
+                        with jax.default_device(_cpu_fallback_device()):
+                            return _run_scan_once(
+                                table, ops, chunk_override, None, defer,
+                                None, scan_ctx, report, window,
+                                select_kernel=select_kernel,
+                                plan_lint=plan_lint,
+                                encoded=encoded_ingest,
+                            )
+
+                    return _governed_attempt(
+                        budget, _fallback_once,
+                        f"scan {scan_id} CPU fallback (run budget)",
                     )
-                else:
-                    SCAN_STATS.record_degradation(
-                        "watchdog_timeout", scan_id=scan_id,
-                        deadline=e.deadline, error=str(e),
-                    )
-            # the degraded-mesh ladder comes BEFORE the whole-backend
-            # ladder: a fault attributable to specific mesh members costs
-            # those members, never the backend — the run continues on the
-            # largest healthy subset, and the CPU fallback is reached only
-            # when no accelerator subset remains
-            if not fallback and _reshard_after(e):
-                if budget is not None:
-                    budget.charge("mesh_reshard", scan_id=scan_id)
-                attempt += 1
-                continue
-            if not fallback:  # CPU-side faults are not accelerator health
-                DEVICE_HEALTH.record_fault(e)
-            # compile / lost / hang with no healthy subset left: retrying
-            # the same program on the same backend cannot help — fall
-            # back or raise typed
-            if can_fallback and not fallback:
-                if budget is not None:
-                    budget.charge("cpu_fallback", scan_id=scan_id)
-                fallback = True
-                attempt += 1
-                SCAN_STATS.record_degradation(
-                    "cpu_fallback", scan_id=scan_id,
-                    reason=type(e).__name__, error=str(e),
+                result = _governed_attempt(
+                    budget,
+                    lambda: _run_scan_once(
+                        table, ops, chunk_override, mesh, defer,
+                        attempt_deadline, scan_ctx, report, window,
+                        select_kernel=select_kernel, plan_lint=plan_lint,
+                        encoded=encoded_ingest,
+                    ),
+                    f"scan {scan_id} attempt {attempt} (run budget)",
                 )
-                continue
-            raise
+                DEVICE_HEALTH.record_success()
+                if n_dev > 1:
+                    MESH_HEALTH.record_success(mesh_device_ids(mesh))
+                return result
+            except DeviceOOMException as e:
+                SCAN_STATS.device_faults += 1
+                if not fallback:  # CPU-side faults are not accelerator health
+                    DEVICE_HEALTH.record_fault(e)
+                used = report.get("chunk") or chunk_override or DEFAULT_CHUNK_ROWS
+                freed = _evict_device_cache(table)
+                # encoded -> decoded demotion FIRST, like the PR-6
+                # selection -> sort re-plan: the encoded attempt's decode
+                # gathers/dictionary LUTs are the allocations the fault
+                # implicates that the decoded program simply doesn't have —
+                # retry on the known-good decoded path at the same chunk
+                # size; a recurring OOM there bisects as before
+                if not fallback and encoded_ingest and report.get("encoded"):
+                    # every ladder retry charges the run budget FIRST: an
+                    # exhausted budget raises typed here instead of spending
+                    # another rung (the charge exception carries the ledger)
+                    if budget is not None:
+                        budget.charge("encoded_demote", scan_id=scan_id)
+                    encoded_ingest = False
+                    SCAN_STATS.encoded_demotions += 1
+                    SCAN_STATS.record_degradation(
+                        "encoded_demote", scan_id=scan_id, chunk=int(used),
+                        evicted_bytes=freed, error=str(e),
+                    )
+                    attempt += 1
+                    continue
+                halved = max(floor, used // 2)
+                halved = max(n_dev, (halved // n_dev) * n_dev)
+                if halved < used and not fallback:
+                    if budget is not None:
+                        budget.charge("oom_bisect", scan_id=scan_id)
+                    depth += 1
+                    SCAN_STATS.oom_bisections += 1
+                    SCAN_STATS.bisection_depth = max(
+                        SCAN_STATS.bisection_depth, depth
+                    )
+                    SCAN_STATS.record_degradation(
+                        "oom_bisect", scan_id=scan_id, chunk_from=int(used),
+                        chunk_to=int(halved), depth=depth, evicted_bytes=freed,
+                        error=str(e),
+                    )
+                    chunk_override = halved
+                    attempt += 1
+                    continue
+                # at the bisection floor: a per-CHIP OOM (the message named
+                # its device) can still shed the sick member and retry on the
+                # healthy remainder before any CPU fallback
+                if not fallback and _reshard_after(e):
+                    if budget is not None:
+                        budget.charge("mesh_reshard", scan_id=scan_id)
+                    attempt += 1
+                    continue
+                # bisection and resharding cannot help any further
+                if can_fallback and not fallback:
+                    if budget is not None:
+                        budget.charge("cpu_fallback", scan_id=scan_id)
+                    fallback = True
+                    attempt += 1
+                    SCAN_STATS.record_degradation(
+                        "cpu_fallback", scan_id=scan_id,
+                        reason="oom_at_bisection_floor", chunk=int(used),
+                        error=str(e),
+                    )
+                    continue
+                raise
+            except DeviceException as e:
+                SCAN_STATS.device_faults += 1
+                if isinstance(e, DeviceHangException):
+                    SCAN_STATS.watchdog_timeouts += 1
+                    # a hang on a multi-chip dispatch is a straggling
+                    # collective only when the PER-SHARD deadline was the one
+                    # that bound (attempt_deadline = min of the two): a hang
+                    # tripping a tighter device_deadline is a general watchdog
+                    # timeout and must not be mislabeled as a straggler
+                    if straggler_armed and (
+                        device_deadline is None
+                        or shard_deadline <= device_deadline
+                    ):
+                        SCAN_STATS.mesh_stragglers += 1
+                        SCAN_STATS.record_degradation(
+                            "mesh_straggler", scan_id=scan_id,
+                            deadline=e.deadline, mesh_size=n_dev, error=str(e),
+                        )
+                    else:
+                        SCAN_STATS.record_degradation(
+                            "watchdog_timeout", scan_id=scan_id,
+                            deadline=e.deadline, error=str(e),
+                        )
+                # the degraded-mesh ladder comes BEFORE the whole-backend
+                # ladder: a fault attributable to specific mesh members costs
+                # those members, never the backend — the run continues on the
+                # largest healthy subset, and the CPU fallback is reached only
+                # when no accelerator subset remains
+                if not fallback and _reshard_after(e):
+                    if budget is not None:
+                        budget.charge("mesh_reshard", scan_id=scan_id)
+                    attempt += 1
+                    continue
+                if not fallback:  # CPU-side faults are not accelerator health
+                    DEVICE_HEALTH.record_fault(e)
+                # compile / lost / hang with no healthy subset left: retrying
+                # the same program on the same backend cannot help — fall
+                # back or raise typed
+                if can_fallback and not fallback:
+                    if budget is not None:
+                        budget.charge("cpu_fallback", scan_id=scan_id)
+                    fallback = True
+                    attempt += 1
+                    SCAN_STATS.record_degradation(
+                        "cpu_fallback", scan_id=scan_id,
+                        reason=type(e).__name__, error=str(e),
+                    )
+                    continue
+                raise
 
 
 def _run_scan_once(
@@ -2960,12 +3040,25 @@ def _prefetch(iterator, depth: int = 2):
     # the ambient run budget is thread-local: re-install it on the
     # reader thread so the source's retry layer keeps charging THIS
     # run's ledger (stream reads are the one charge site that executes
-    # over here)
+    # over here); same for the flight recorder, so read-retry events
+    # record against this run's trace
     budget = current_run_budget()
+    rec = current_recorder()
+    rec_parent = rec.current_span_id() if rec is not None else None
+
+    # scope the recorder only when one is armed: an unconditional
+    # recording_scope(None) would bump the global armed counter (and
+    # install a suppress slot) for the stream's whole lifetime, pushing
+    # every disarmed current_recorder() call in the process off the
+    # one-integer fast path
+    rec_scope = (
+        recording_scope(rec, rec_parent) if rec is not None
+        else nullcontext()
+    )
 
     def run():
         try:
-            with run_budget_scope(budget):
+            with run_budget_scope(budget), rec_scope:
                 for item in iterator:
                     while not stop.is_set():
                         try:

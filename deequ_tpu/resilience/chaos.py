@@ -386,6 +386,11 @@ class ChaosReport:
     run_budget: dict = field(default_factory=dict)
     retry_stats: dict = field(default_factory=dict)
     scan_delta: dict = field(default_factory=dict)
+    #: failed-I/O-try delta read THROUGH the unified obs registry
+    #: (deequ_tpu/obs/registry — the read-through "retry" section):
+    #: oracle 7 compares the budget's io_retry charges against this,
+    #: proving the round-11 unification didn't fork the counters
+    retry_observed: Optional[int] = None
     injected: List[tuple] = field(default_factory=list)
     resident_after: int = 0
     drifted: bool = False
@@ -500,10 +505,19 @@ def run_schedule(
 
     result = None
     exc: Optional[BaseException] = None
-    scan_before = SCAN_STATS.snapshot()
+    from deequ_tpu.obs.registry import REGISTRY
+
     try:
         with fault_state_scope():
             install_scan_fault_hook(hook)
+            # ledger capture goes THROUGH the unified registry (its
+            # "scan"/"retry" sections are read-through views over
+            # SCAN_STATS / RETRY_TELEMETRY): oracle 7 checking deltas
+            # of THIS snapshot proves the unification didn't fork the
+            # counters. Captured inside fault_state_scope — the scope
+            # resets RETRY_TELEMETRY on entry and restores it on exit,
+            # so the delta must bracket the run, not the scope.
+            reg_before = REGISTRY.snapshot()
             t0 = time.monotonic()
             try:
                 result = VerificationSuite.do_verification_run(
@@ -522,11 +536,13 @@ def run_schedule(
             except Exception as e:  # noqa: BLE001
                 exc = e
             elapsed = time.monotonic() - t0
+            reg_after = REGISTRY.snapshot()
     finally:
         # even a BaseException escaping the run (KeyboardInterrupt) must
         # not leave the fault-injecting chaosfs:// scheme registered
         restore_fs()
-    scan_after = SCAN_STATS.snapshot()
+    scan_before = reg_before["scan"]
+    scan_after = reg_after["scan"]
 
     injected = list(hook.injected) + list(batch_schedule.injected)
     if fs_schedule is not None:
@@ -553,6 +569,9 @@ def run_schedule(
         ),
         run_budget=dict(result.run_budget) if result is not None else {},
         retry_stats=dict(result.retry_stats) if result is not None else {},
+        retry_observed=(
+            reg_after["retry"]["attempts"] - reg_before["retry"]["attempts"]
+        ),
         scan_delta={
             k: scan_after[k] - scan_before[k]
             for k in (
@@ -681,11 +700,28 @@ def _check_oracles(
         ):
             v.append("budget ledger: over cap without exhaustion")
         io_charged = charges.get("io_retry", 0)
-        io_observed = report.retry_stats.get("attempts", 0)
+        # read through the unified registry (report.retry_observed =
+        # the registry "retry" section's attempts delta): if the
+        # round-11 unification had forked the counters, the registry
+        # view would drift from the budget ledger and this trips
+        io_observed = (
+            report.retry_observed
+            if report.retry_observed is not None
+            else report.retry_stats.get("attempts", 0)
+        )
         if io_charged != io_observed:
             v.append(
                 f"budget ledger: io_retry charges ({io_charged}) != "
                 f"retry telemetry attempts ({io_observed})"
+            )
+        if report.retry_observed is not None and (
+            report.retry_observed != report.retry_stats.get("attempts", 0)
+        ):
+            v.append(
+                "budget ledger: registry retry view "
+                f"({report.retry_observed}) != result.retry_stats "
+                f"({report.retry_stats.get('attempts', 0)}) — the "
+                "unified registry forked the counters"
             )
 
     # 6. fetch contract: at most one device->host fetch per scan pass

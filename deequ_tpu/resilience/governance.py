@@ -149,6 +149,16 @@ class RunBudget:
             SCAN_STATS.budget_charges += 1
         except ImportError:  # charge sites can run before the engine loads
             pass
+        # flight-recorder seam: every charge is an instant event on the
+        # armed recording (the "which rung ate the budget" timeline);
+        # disarmed cost is one integer check
+        from deequ_tpu.obs.recorder import current_recorder
+
+        rec = current_recorder()
+        if rec is not None:
+            rec.event(
+                "budget_charge", charge_kind=kind, attempts=self.attempts,
+            )
         reason = self.exhausted_reason
         if reason is None:
             cap = self.policy.max_total_attempts

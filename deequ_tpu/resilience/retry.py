@@ -59,6 +59,14 @@ class RetryTelemetry:
 
     def record_attempt(self) -> None:
         self.attempts += 1
+        # flight-recorder seam: a FAILED I/O try is an instant event on
+        # the armed recording (the clean fast path never reaches here,
+        # so healthy traced runs record nothing from this layer)
+        from deequ_tpu.obs.recorder import current_recorder
+
+        rec = current_recorder()
+        if rec is not None:
+            rec.event("io_retry", attempts=self.attempts)
 
     def record_retry(self, slept: float, exc: BaseException) -> None:
         self.retries += 1
@@ -68,6 +76,13 @@ class RetryTelemetry:
     def record_exhausted(self, exc: BaseException) -> None:
         self.exhausted += 1
         self.last_exception = f"{type(exc).__name__}: {exc}"
+        from deequ_tpu.obs.recorder import current_recorder
+
+        rec = current_recorder()
+        if rec is not None:
+            rec.event(
+                "io_retry_exhausted", error=f"{type(exc).__name__}: {exc}"
+            )
 
     def snapshot(self) -> dict:
         return {

@@ -302,8 +302,21 @@ def run_coalesced(
         k_bucket <<= 1
 
     t_start = time.time()
-    bufs = _stack_member_buffers(plan, tables, k_bucket, packers)
-    lut_host, lut_sig = stack_luts(plan, tables, k_bucket)
+    # coalesced-batch assembly is host work worth its own span: K tables
+    # pack + stack + LUTs pad to the group max — the serving path's one
+    # per-batch host cost that scales with K
+    from contextlib import nullcontext
+
+    from deequ_tpu.obs.recorder import current_recorder
+
+    rec = current_recorder()
+    with (
+        rec.span("coalesce_assembly", tenants=K, bucket=k_bucket)
+        if rec is not None
+        else nullcontext()
+    ):
+        bufs = _stack_member_buffers(plan, tables, k_bucket, packers)
+        lut_host, lut_sig = stack_luts(plan, tables, k_bucket)
 
     # plan_scan_ops with no packer (members pack host-side, fresh per
     # batch): carry the GROUP layout + encoded declaration explicitly so

@@ -116,6 +116,11 @@ class VerificationFuture:
         self._cancelled = False
         self._started = False
         self._lock = threading.Lock()
+        # the service's observation seam (obs/registry latency histogram
+        # + optional flight-recorder submit->resolve span): called once,
+        # after resolve/reject — never for a cancel (no latency to
+        # observe on work that never ran)
+        self._on_done = None
 
     # -- consumer side ---------------------------------------------------
 
@@ -167,11 +172,15 @@ class VerificationFuture:
         self._result = result
         self.resolved_at = time.monotonic()
         self._done.set()
+        if self._on_done is not None:
+            self._on_done(self, True)
 
     def _reject(self, error: BaseException) -> None:
         self._error = error
         self.resolved_at = time.monotonic()
         self._done.set()
+        if self._on_done is not None:
+            self._on_done(self, False)
 
 
 @dataclass
@@ -230,11 +239,25 @@ class VerificationService:
     """The long-lived serving entry point (see module doc)."""
 
     def __init__(self, config: Optional[ServeConfig] = None, start: bool = True,
-                 **knobs):
+                 trace=None, **knobs):
+        from deequ_tpu.obs.recorder import (
+            current_recorder,
+            maybe_arm_from_env,
+            resolve_recorder,
+        )
         from deequ_tpu.parallel.mesh import current_mesh
         from deequ_tpu.serve.plan_cache import PlanCache
 
         self.config = config if config is not None else ServeConfig(**knobs)
+        # flight recorder: like the mesh, the recorder is resolved at
+        # CONSTRUCTION (the worker thread has no ambient scope of its
+        # own) — explicit ``trace`` argument > the constructing thread's
+        # ambient scope > the DEEQU_TPU_TRACE-armed global
+        maybe_arm_from_env()
+        self._recorder = (
+            resolve_recorder(trace) if trace is not None
+            else current_recorder()
+        )
         self.plan_cache = PlanCache(self.config.plan_cache_size)
         self.tenant_health = _TenantHealth(self.config.quarantine_after)
         # the mesh is thread-local: capture the constructing thread's
@@ -308,6 +331,10 @@ class VerificationService:
             if self._closed:
                 raise ServiceClosedException("service is stopped")
             for req in pending:
+                # re-bind the observation seam: the adopted future must
+                # observe into THIS service's recorder, not the stopped
+                # donor's
+                req.future._on_done = self._observe_done
                 self._pending.append(req)
             self._cv.notify_all()
 
@@ -348,7 +375,10 @@ class VerificationService:
         backpressure is typed — a full queue raises
         ``ServiceOverloadedException`` instead of buffering without
         bound."""
+        from deequ_tpu.obs.registry import SERVE_SUBMITTED
+
         future = VerificationFuture(tenant)
+        future._on_done = self._observe_done
         req = ServeRequest(
             data=data,
             checks=tuple(checks),
@@ -373,6 +403,15 @@ class VerificationService:
                     f"max_pending={self.config.max_pending}"
                 )
             self._pending.append(req)
+            # accounting AFTER the enqueue succeeded but BEFORE the
+            # worker is notified: SERVE_SUBMITTED means "accepted" (a
+            # typed closed/overload refusal above must not count), and
+            # incrementing outside the lock would let a fast worker
+            # resolve the request first — a concurrent scrape would see
+            # resolved > submitted
+            SERVE_SUBMITTED.inc()
+            if self._recorder is not None:
+                self._recorder.event("serve_submit", tenant=str(tenant))
             self._cv.notify_all()
         return future
 
@@ -380,12 +419,50 @@ class VerificationService:
         """Synchronous convenience: submit + wait."""
         return self.submit(data, checks, **kw).result()
 
+    def _observe_done(self, future: VerificationFuture, ok: bool) -> None:
+        """Per-request observation seam, called exactly once per
+        resolved/rejected future: feed the ALWAYS-ON registry latency
+        histogram (per-tenant + aggregate — the p50/p95/p99 the bench
+        probes previously re-derived per run) and, when tracing is
+        armed, record the whole submit->resolve span retroactively on a
+        synthetic per-tenant track (submit happened on the caller
+        thread, resolve on the worker — the future's monotonic stamps
+        are the span's bounds)."""
+        from deequ_tpu.obs.registry import (
+            SERVE_LATENCY,
+            SERVE_REJECTED,
+            SERVE_RESOLVED,
+        )
+
+        (SERVE_RESOLVED if ok else SERVE_REJECTED).inc()
+        latency = future.latency_seconds
+        if latency is None:
+            return
+        tenant = "?" if future.tenant is None else str(future.tenant)
+        SERVE_LATENCY.observe(tenant, latency)
+        if self._recorder is not None:
+            self._recorder.record_span(
+                "serve_request",
+                future.submitted_at,
+                future.resolved_at,
+                track=f"tenant/{tenant}",
+                tenant=tenant,
+                ok=ok,
+            )
+
     # -- worker ----------------------------------------------------------
 
     def _worker(self) -> None:
+        from contextlib import nullcontext
+
+        from deequ_tpu.obs.recorder import recording_scope
         from deequ_tpu.parallel.mesh import use_mesh
 
-        with use_mesh(self._mesh):
+        with use_mesh(self._mesh), (
+            recording_scope(self._recorder)
+            if self._recorder is not None
+            else nullcontext()
+        ):
             while True:
                 batch = self._take_batch()
                 if batch is None:
@@ -427,6 +504,9 @@ class VerificationService:
                     self._cv.wait(left)
         out: List[ServeRequest] = []
         with self._cv:
+            from deequ_tpu.obs.registry import SERVE_QUEUE_DEPTH
+
+            SERVE_QUEUE_DEPTH.set(len(self._pending))
             while self._pending and len(out) < cfg.max_batch:
                 out.append(self._pending.popleft())
         return out

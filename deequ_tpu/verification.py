@@ -83,7 +83,20 @@ class VerificationResult:
       result: the analyzers whose scans could not finish carry typed
       ``RunBudgetExhaustedException`` failure metrics and the rows never
       verified land on ``unverified_row_ranges`` (kind
-      ``budget_exhausted`` in ``device_events``)."""
+      ``budget_exhausted`` in ``device_events``).
+
+    Flight-recorder tracing (deequ_tpu/obs; armed via
+    ``with_tracing()`` / ``do_verification_run(trace=...)`` /
+    ``DEEQU_TPU_TRACE=1``, off by default):
+
+    - ``run_trace`` — the compact per-phase wall breakdown of the run's
+      recording (span/event counts, per-phase wall seconds — the
+      dispatch/drain phase sums reconcile with
+      ``scan_stats``'s ``dispatch_seconds``/``drain_wait_seconds``);
+      empty when the run was untraced;
+    - ``trace_recorder`` — the :class:`~deequ_tpu.obs.FlightRecorder`
+      itself (export with ``deequ_tpu.obs.write_chrome_trace``); None
+      when untraced."""
 
     status: CheckStatus
     check_results: Dict[Check, CheckResult]
@@ -98,6 +111,10 @@ class VerificationResult:
     unverified_row_ranges: List[tuple] = field(default_factory=list)
     plan_lints: List[dict] = field(default_factory=list)
     run_budget: Dict[str, object] = field(default_factory=dict)
+    run_trace: Dict[str, object] = field(default_factory=dict)
+    trace_recorder: Optional[object] = field(
+        default=None, repr=False, compare=False
+    )
 
     @staticmethod
     def success_metrics_as_rows(
@@ -213,6 +230,7 @@ class VerificationSuite:
         run_deadline: Optional[float] = None,
         max_total_attempts: Optional[int] = None,
         on_budget_exhausted: Optional[str] = None,
+        trace=None,
     ) -> VerificationResult:
         """Resilience knobs (streaming tables; deequ_tpu/resilience):
         ``checkpoint`` (StreamCheckpointer or directory path) makes the
@@ -255,7 +273,24 @@ class VerificationSuite:
         could not finish, exact ``unverified_row_ranges`` for the rows
         never verified — while ``"raise"`` propagates a typed
         ``RunBudgetExhaustedException``. The ledger lands on
-        ``result.run_budget``."""
+        ``result.run_budget``.
+
+        Tracing knob (deequ_tpu/obs): ``trace`` arms the flight
+        recorder for THIS run — a
+        :class:`~deequ_tpu.obs.FlightRecorder`, ``True`` (the
+        env-armed global recorder, else a fresh run-scoped one), or
+        ``False`` (suppress an env-armed one). Never process-wide: one
+        traced run leaves later runs disarmed. Every engine seam of the run records typed spans/events;
+        the per-phase summary lands on ``result.run_trace`` and the
+        recorder on ``result.trace_recorder`` (export via
+        ``deequ_tpu.obs.write_chrome_trace``). Also armable
+        process-wide via ``DEEQU_TPU_TRACE=1``."""
+        from deequ_tpu.obs.recorder import (
+            current_recorder,
+            maybe_arm_from_env,
+            recording_scope,
+            resolve_recorder,
+        )
         from deequ_tpu.ops.scan_engine import SCAN_STATS
         from deequ_tpu.resilience.governance import (
             current_run_budget,
@@ -300,12 +335,31 @@ class VerificationSuite:
             if run_policy is not None:
                 budget = armed_here = run_policy.arm()
 
-        from contextlib import nullcontext
+        # flight recorder: explicit ``trace`` argument > the caller's
+        # ambient scope > the DEEQU_TPU_TRACE-armed global recorder. A
+        # traced run wraps everything (peer check + analysis) in one
+        # root span; the summary lands on result.run_trace below.
+        maybe_arm_from_env()
+        recorder = (
+            resolve_recorder(trace) if trace is not None
+            else current_recorder()
+        )
+        # run_trace must be a per-RUN delta even on a shared/env-armed
+        # recorder that outlives this run: summarize from here on
+        import time as _time
 
-        with (
-            run_budget_scope(budget) if armed_here is not None
-            else nullcontext()
-        ):
+        trace_since = _time.monotonic() if recorder is not None else None
+        trace_dropped0 = recorder.dropped if recorder is not None else 0
+
+        from contextlib import ExitStack
+
+        with ExitStack() as _scopes:
+            if trace is not None:
+                _scopes.enter_context(recording_scope(recorder))
+            if recorder is not None:
+                _scopes.enter_context(recorder.span("verification_run"))
+            if armed_here is not None:
+                _scopes.enter_context(run_budget_scope(budget))
             # the peer check runs INSIDE the run (after the telemetry
             # baseline capture) so a degraded outcome lands on THIS
             # result's unverified_row_ranges/mesh_events delta
@@ -380,6 +434,11 @@ class VerificationSuite:
             result.fallback_backend = SCAN_STATS.fallback_backend
         if budget is not None:
             result.run_budget = budget.snapshot()
+        if recorder is not None:
+            result.run_trace = recorder.summary(
+                since=trace_since, dropped_baseline=trace_dropped0
+            )
+            result.trace_recorder = recorder
         result.retry_stats = RETRY_TELEMETRY.delta_since(retry_before)
         result.scan_stats = {
             k: round(getattr(SCAN_STATS, k) - v, 6)
@@ -594,6 +653,7 @@ class VerificationRunBuilder:
         self._run_deadline: Optional[float] = None
         self._max_total_attempts: Optional[int] = None
         self._on_budget_exhausted: Optional[str] = None
+        self._trace = None
 
     def add_check(self, check: Check) -> "VerificationRunBuilder":
         self._checks.append(check)
@@ -765,6 +825,35 @@ class VerificationRunBuilder:
         self._on_budget_exhausted = on_budget_exhausted
         return self
 
+    def with_tracing(
+        self, recorder=None, capacity: Optional[int] = None
+    ) -> "VerificationRunBuilder":
+        """Arm the flight recorder (deequ_tpu/obs) for this run: every
+        engine seam — program trace, plan lint, staging, dispatch,
+        drain, fault-ladder rungs, budget charges — records typed
+        spans/events. Pass a :class:`~deequ_tpu.obs.FlightRecorder` to
+        share one across runs, or let this create a fresh one
+        (``capacity`` bounds its ring buffer). The per-phase summary
+        lands on ``result.run_trace`` and the recorder on
+        ``result.trace_recorder`` — export with
+        ``deequ_tpu.obs.write_chrome_trace(result.trace_recorder,
+        path)``. Tracing is otherwise OFF; also armable process-wide
+        via ``DEEQU_TPU_TRACE=1``."""
+        from deequ_tpu.obs.recorder import FlightRecorder
+
+        if recorder is None:
+            recorder = (
+                FlightRecorder(capacity=capacity)
+                if capacity is not None
+                else FlightRecorder()
+            )
+        elif capacity is not None:
+            raise ValueError(
+                "pass either an existing recorder or a capacity, not both"
+            )
+        self._trace = recorder
+        return self
+
     def save_check_results_json_to_path(self, path: str) -> "VerificationRunBuilder":
         self._check_results_path = path
         return self
@@ -808,6 +897,7 @@ class VerificationRunBuilder:
             run_deadline=self._run_deadline,
             max_total_attempts=self._max_total_attempts,
             on_budget_exhausted=self._on_budget_exhausted,
+            trace=self._trace,
         )
 
 

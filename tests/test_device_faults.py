@@ -750,13 +750,18 @@ def test_group_budget_with_checkpoint_warns_once_per_run(tmp_path):
 def test_execution_report_includes_device_counters():
     import deequ_tpu
 
-    report = deequ_tpu.execution_report()
+    # round 11: execution_report() is the unified registry snapshot;
+    # the device counters live in its "scan" section, and the old flat
+    # shape survives as the deprecation-free scan_execution_report()
+    report = deequ_tpu.execution_report()["scan"]
+    legacy = deequ_tpu.scan_execution_report()
     for key in (
         "device_faults", "oom_bisections", "bisection_depth",
         "watchdog_timeouts", "fallback_scans", "fallback_backend",
         "degradation_events",
     ):
         assert key in report
+        assert key in legacy
     # the snapshot's event list is a copy, not a live view
     report["degradation_events"].append({"kind": "bogus"})
     assert all(
