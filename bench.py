@@ -966,6 +966,224 @@ def measure_serving_load(n_tenants: int, rows_per_tenant: int = 256):
     }
 
 
+def measure_fleet_failover(n_tenants: int, n_workers: int = 4):
+    """Fleet-tier probe (round 12, deequ_tpu/serve/fleet.py — ROADMAP
+    item 1's acceptance shape): an open-loop ``n_tenants``-tenant load
+    of small suites over ``n_workers`` serving workers placed by the
+    consistent-hash router, vs the SAME load through a single worker —
+    then a scripted mid-load worker death with its failover re-dispatch.
+
+    Contract asserts (the probe REFUSES to report on violation, like the
+    serving/one-fetch/config-3 asserts):
+
+    - DEATH DEGRADES ONLY ITS IN-FLIGHT TENANTS: killing one wedged
+      worker re-dispatches exactly that worker's accepted requests (the
+      fleet ledger count equals the victim's routed tenants) — no other
+      tenant's request moves;
+    - FAILOVER BIT-IDENTITY: every tenant of the death pass (the
+      re-dispatched victims included) resolves bit-identical to its
+      healthy per-tenant serial run — plans are deterministic;
+    - EXACTLY-ONCE: every accepted future of every pass resolves exactly
+      once (chaos oracle 8's observable) — none orphaned, none
+      double-resolved;
+    - NEAR-LINEAR SCALING — armed only on hardware that can express it:
+      with >= ``n_workers`` devices AND cpu cores, sustained fleet
+      suites/s must be >= 0.6 x n_workers x the single-worker rate. On
+      this container's 1-device/2-vCPU shape the workers share one chip
+      and the GIL, so the probe banks the measured ratio under
+      ``fleet_scaling_gate: "pending-parallel-hw"`` (the config-3
+      banked-acceptance idiom) and gates instead on NO COLLAPSE: the
+      routed fleet must keep >= 0.5x the single-worker rate (placement,
+      the shared quarantine ledger, and the fleet ledger cost bounded).
+    """
+    import os
+    import struct
+
+    import jax
+
+    from deequ_tpu import VerificationSuite
+    from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.serve import VerificationFleet
+
+    N_SHAPES = 12  # distinct row counts -> distinct digests -> ring spread
+
+    def analyzers():
+        return [Size(), Completeness("x"), Mean("x"), Sum("i")]
+
+    def tenant_table(shape: int, seed: int):
+        r = np.random.default_rng(seed)
+        n = 64 + 16 * shape  # the shape's row count IS its routing key
+        return ColumnarTable([
+            Column("x", DType.FRACTIONAL, values=r.normal(100, 5, n),
+                   mask=r.random(n) > 0.05),
+            Column("i", DType.INTEGRAL,
+                   values=r.integers(0, 50, n).astype(np.float64),
+                   mask=np.ones(n, bool)),
+        ])
+
+    load = [
+        (f"tenant-{t}", tenant_table(t % N_SHAPES, 7000 + t))
+        for t in range(n_tenants)
+    ]
+
+    def bits(v):
+        return struct.pack("<d", v) if isinstance(v, float) else v
+
+    def run_pass(fleet):
+        t0 = time.time()
+        futures = [
+            fleet.submit(table, required_analyzers=analyzers(), tenant=t)
+            for t, table in load
+        ]
+        results = {
+            t: f.result(timeout=600) for (t, _), f in zip(load, futures)
+        }
+        return time.time() - t0, futures, results
+
+    def assert_exactly_once(futures, label):
+        bad = [f.tenant for f in futures if f.resolve_count != 1]
+        assert not bad, (
+            f"fleet violation ({label}): futures resolved != exactly "
+            f"once for {bad[:5]} — chaos oracle 8 is gone"
+        )
+
+    with use_mesh(None):
+        serial_sample = {
+            t: VerificationSuite.run(tbl, [], required_analyzers=analyzers())
+            for t, tbl in load[:: max(1, n_tenants // 24)]
+        }
+
+        # -- single-worker denominator (same fleet machinery, 1 worker)
+        one = VerificationFleet(
+            n_workers=1, monitor=False, distinct_devices=False,
+        )
+        try:
+            run_pass(one)  # warm: plan builds + compiles
+            one_wall = float("inf")
+            for _ in range(3):
+                wall, futures, _ = run_pass(one)
+                one_wall = min(one_wall, wall)
+            assert_exactly_once(futures, "single-worker")
+        finally:
+            one.stop(drain=True)
+        one_persec = n_tenants / max(one_wall, 1e-9)
+
+        # -- the fleet: routed load, steady-state throughput
+        fleet = VerificationFleet(
+            n_workers=n_workers, monitor=False, distinct_devices=True,
+        )
+        try:
+            run_pass(fleet)  # warm every worker's routed plans
+            fleet.prewarm()  # survivors pre-hold each other's hot plans
+            fleet_wall = float("inf")
+            for _ in range(3):
+                wall, futures, _ = run_pass(fleet)
+                fleet_wall = min(fleet_wall, wall)
+            assert_exactly_once(futures, "fleet-healthy")
+            routed = {
+                t: fleet.route(tbl, required_analyzers=analyzers())
+                for t, tbl in load
+            }
+            occupancy = {w: 0 for w in range(n_workers)}
+            for w in routed.values():
+                occupancy[w] += 1
+            workers_hit = sum(1 for n in occupancy.values() if n)
+
+            # -- scripted mid-load death: wedge the busiest worker so
+            # its queue holds, submit the load, kill it, gather
+            victim = max(occupancy, key=occupancy.get)
+            victims = [t for t, w in routed.items() if w == victim]
+            # the bit-identity gate must cover EVERY re-dispatched
+            # victim, not just the stride sample (shape = t % N_SHAPES
+            # and a stride can systematically miss every shape the
+            # victim worker owns): add the victims' serial references
+            tables_by_tenant = dict(load)
+            for t in victims:
+                if t not in serial_sample:
+                    serial_sample[t] = VerificationSuite.run(
+                        tables_by_tenant[t], [],
+                        required_analyzers=analyzers(),
+                    )
+            fleet.stall_worker(victim, seconds=600.0)
+            time.sleep(0.1)
+            death_t0 = time.time()
+            futures = [
+                fleet.submit(tbl, required_analyzers=analyzers(), tenant=t)
+                for t, tbl in load
+            ]
+            redispatched = fleet.kill_worker(victim)
+            results = {
+                t: f.result(timeout=600) for (t, _), f in zip(load, futures)
+            }
+            death_wall = time.time() - death_t0
+            assert_exactly_once(futures, "death-pass")
+            assert redispatched == len(victims), (
+                f"fleet violation: worker {victim} owned {len(victims)} "
+                f"accepted requests but {redispatched} were re-dispatched "
+                "— failover must move exactly the dead worker's in-flight "
+                "tenants, no more, no fewer"
+            )
+            assert fleet.requests_redispatched == redispatched, (
+                "fleet violation: a healthy worker's request was "
+                "re-dispatched — death must degrade ONLY the dead "
+                "worker's in-flight tenants"
+            )
+            for t, serial in serial_sample.items():
+                served = results[t]
+                assert str(serial.status) == str(served.status), t
+                for a, m1 in serial.metrics.items():
+                    m2 = served.metrics[a]
+                    assert m1.value.is_success and m2.value.is_success, (t, a)
+                    assert bits(m1.value.get()) == bits(m2.value.get()), (
+                        f"fleet violation: {t} {a} after scripted death "
+                        f"{m2.value.get()!r} != serial {m1.value.get()!r} "
+                        "— failover re-dispatch must be BIT-identical"
+                    )
+            stats = fleet.stats()
+        finally:
+            fleet.stop(drain=True)
+
+    fleet_persec = n_tenants / max(fleet_wall, 1e-9)
+    scaling = fleet_persec / max(one_persec, 1e-9)
+    parallel_hw = (
+        len(jax.devices()) >= n_workers
+        and (os.cpu_count() or 1) >= n_workers
+    )
+    if parallel_hw:
+        floor = 0.6 * n_workers
+        gate = "armed"
+        assert scaling >= floor, (
+            f"fleet violation: {n_workers} workers over "
+            f"{len(jax.devices())} devices sustain only {scaling:.2f}x "
+            f"the single-worker rate — the near-linear (> {floor:.1f}x) "
+            "fleet scaling contract is gone"
+        )
+    else:
+        floor = 0.5
+        gate = "pending-parallel-hw"
+        assert scaling >= floor, (
+            f"fleet violation: the routed fleet collapsed to "
+            f"{scaling:.2f}x the single-worker rate on the shared-device "
+            "container — placement/ledger overhead must stay bounded "
+            f"(>= {floor}x) even without parallel hardware"
+        )
+    return {
+        "fleet_suites_per_sec": round(fleet_persec, 1),
+        "fleet_single_worker_suites_per_sec": round(one_persec, 1),
+        "fleet_scaling_x": round(scaling, 2),
+        "fleet_scaling_gate": gate,
+        "fleet_n_workers": n_workers,
+        "fleet_workers_occupied": workers_hit,
+        "fleet_death_pass_wall_s": round(death_wall, 3),
+        "fleet_failover_victim_tenants": len(victims),
+        "fleet_failover_redispatched": redispatched,
+        "fleet_failovers_total": stats["failovers"],
+        "fleet_workers_alive_after_death": stats["workers_alive"],
+    }
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -1107,10 +1325,16 @@ def main():
     # asserted inside
     serving_probe = measure_serving_load(200 if smoke else 1000)
     print(f"serving probe: {serving_probe}", file=sys.stderr)
+    # fleet probe (round 12): routed multi-worker load + scripted-death
+    # failover with the degrades-only-in-flight / bit-identity /
+    # exactly-once gates asserted inside (the near-linear scaling gate
+    # arms itself only on >= 4-device hardware)
+    fleet_probe = measure_fleet_failover(48 if smoke else 144)
+    print(f"fleet probe: {fleet_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
         **lint_probe, **ingest_probe, **governance_probe, **obs_probe,
-        **serving_probe,
+        **serving_probe, **fleet_probe,
     }
 
     if smoke:

@@ -112,6 +112,30 @@ def config6(n_tenants: int):
     )
 
 
+def config7(n_tenants: int):
+    """FLEET config (round 12, deequ_tpu/serve/fleet.py): the config-6
+    load routed over 4 serving workers by consistent hash, plus a
+    scripted mid-load worker death. ONE workload definition, shared with
+    bench.py's ``measure_fleet_failover`` probe, which hard-asserts —
+    before it reports anything — that the death re-dispatches exactly
+    the dead worker's accepted requests, every result (re-dispatched
+    included) is bit-identical to the healthy serial run, every accepted
+    future resolves exactly once (chaos oracle 8), and throughput
+    scales near-linearly vs one worker (a gate that arms itself only on
+    >= 4-device hardware; on a shared-device container it banks the
+    measured ratio as ``pending-parallel-hw`` and gates on
+    no-collapse >= 0.5x instead — the config-3 banked-acceptance
+    idiom)."""
+    import bench
+
+    probe = bench.measure_fleet_failover(n_tenants)
+    return _emit(
+        config=7, metric="fleet_suites_per_sec", tenants=n_tenants,
+        value=probe["fleet_suites_per_sec"], unit="suites/sec",
+        **{k: v for k, v in probe.items() if k != "fleet_suites_per_sec"},
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -627,6 +651,9 @@ def main():
         # round-10 serving config: 1k-tenant open-loop suite load through
         # the multi-tenant service (plan cache + coalescer), suites/sec
         6: lambda: config6(args.rows or 1000),
+        # round-12 fleet config: the routed 4-worker load + scripted
+        # worker death (failover bit-identity / exactly-once asserted)
+        7: lambda: config7(args.rows or 144),
     }
     if args.all:
         for k in sorted(runners):

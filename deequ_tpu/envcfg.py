@@ -233,6 +233,20 @@ SERVE_COALESCE_WINDOW = register(EnvVar(
     minimum=0.0,
     doc="seconds the serve worker waits for co-batchable submissions",
 ))
+FLEET_WORKERS = register(EnvVar(
+    "DEEQU_TPU_FLEET_WORKERS", "int", default=None, minimum=1,
+    doc="VerificationFleet worker count (PR 12; unset = one per device, "
+        "capped at 4)",
+))
+HEARTBEAT_INTERVAL = register(EnvVar(
+    "DEEQU_TPU_HEARTBEAT_INTERVAL", "float", default=0.25, minimum=0.005,
+    doc="fleet membership heartbeat-probe period (s) for worker liveness",
+))
+FAILOVER_RETRIES = register(EnvVar(
+    "DEEQU_TPU_FAILOVER_RETRIES", "int", default=2, minimum=0,
+    doc="max worker-loss re-dispatches one accepted request may ride "
+        "before it rejects typed (WorkerLostException)",
+))
 TRACE = register(EnvVar(
     "DEEQU_TPU_TRACE", "flag01", default=False,
     doc="1 arms the process-global flight recorder (deequ_tpu/obs)",

@@ -279,6 +279,23 @@ class PeerLostException(DeviceException):
         self.lost_processes = tuple(lost_processes)
 
 
+class WorkerLostException(DeviceException):
+    """A serving-fleet worker (deequ_tpu/serve/fleet.py) died or stopped
+    heartbeating: its process/thread is gone or it stalled past the
+    membership timeout. ``worker_ids`` names the lost fleet members —
+    the in-process analogue of ``PeerLostException``'s lost hosts. The
+    fleet responds with FAILOVER, not abort: the lost worker's accepted
+    requests re-dispatch onto surviving workers on their ORIGINAL
+    futures (each re-dispatch charging the tenant's own run budget, kind
+    ``worker_failover``); this exception reaches a caller only when no
+    survivor remains or a request exhausted its failover retries."""
+
+    def __init__(self, message: str, worker_ids: Tuple[int, ...] = (),
+                 boundary: str = "execute"):
+        super().__init__(message, boundary)
+        self.worker_ids = tuple(worker_ids)
+
+
 # message patterns per class, checked in order — OOM first (an OOM during
 # compilation must bisect, not fall back), then compile, then lost
 _OOM_RE = re.compile(

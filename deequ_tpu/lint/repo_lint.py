@@ -85,6 +85,11 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # sits beside every device seam, and an accidental fetch or
     # swallowed fault in the observability layer would be the least
     # observable bug of all.
+    # The round-12 fleet modules (serve/fleet.py, serve/membership.py,
+    # serve/router.py) ride the existing serve/ prefix: failover and
+    # membership code wraps the same device-adjacent seams, so the
+    # host-fetch / bare-except / typed-raise disciplines apply there
+    # unchanged — a swallowed WorkerLostException would strand futures.
     "host-fetch": ("ops/", "parallel/", "anomaly/", "serve/", "obs/"),
     "bare-except": ("ops/", "parallel/", "resilience/", "serve/", "obs/"),
     "jit-impure": ("",),

@@ -434,6 +434,21 @@ SERVE_REJECTED = REGISTRY.counter(
 )
 
 
+# -- the fleet tier's owned instruments (serve/fleet.py; the "fleet"
+#    collector section — per-worker queue depths + the hot-plan feed —
+#    is registered by the fleet module itself, read-through over the
+#    active fleet) ------------------------------------------------------------
+
+FLEET_FAILOVERS = REGISTRY.counter(
+    "fleet_failovers",
+    "worker-loss failover events (serve/fleet.py; one per lost worker)",
+)
+FLEET_WORKERS_ALIVE = REGISTRY.gauge(
+    "fleet_workers_alive",
+    "alive workers of the active VerificationFleet",
+)
+
+
 def _serve_section() -> dict:
     from deequ_tpu.ops.scan_engine import SCAN_STATS
 

@@ -93,6 +93,28 @@ def host_row_range(total_rows: int) -> Tuple[int, int]:
 # -- peer loss ---------------------------------------------------------------
 
 
+def probe_liveness(
+    expected: Sequence[int],
+    timeout: float,
+    probe: Callable[[float], Sequence[int]],
+) -> Tuple[List[int], List[int]]:
+    """Run one injected liveness probe over the ``expected`` member ids
+    and attribute the outcome: returns ``(alive, lost)``, both sorted.
+
+    This is the ``check_peers`` probe seam factored out so OTHER
+    membership tiers can ride it — the serving fleet's worker heartbeat
+    (``serve/membership.py``) injects a thread-liveness probe here
+    exactly the way tests inject deterministic peer probes. The contract
+    is the probe's: ``probe(timeout)`` returns the responsive member
+    ids; a ``TimeoutError`` means the stall could not be attributed and
+    propagates for the caller to convert into its typed loss exception
+    (every member suspect)."""
+    alive = sorted(int(p) for p in probe(timeout))
+    expected_set = {int(i) for i in expected}
+    lost = sorted(expected_set - set(alive))
+    return [p for p in alive if p in expected_set], lost
+
+
 @dataclass
 class PeerLossReport:
     """The outcome of one peer-health check.
@@ -217,7 +239,7 @@ def check_peers(
         return report
     probe = probe or _default_peer_probe
     try:
-        alive = sorted(int(p) for p in probe(timeout))
+        alive, lost = probe_liveness(range(n_proc), timeout, probe)
     except TimeoutError as e:
         # unattributable stall: degrading would silently drop unknown
         # rows, so even "degrade" raises typed here
@@ -225,7 +247,6 @@ def check_peers(
             f"multi-host barrier timed out after {timeout:g}s and the "
             f"stall could not be attributed to specific peers: {e}",
         ) from e
-    lost = [p for p in range(n_proc) if p not in alive]
     report.surviving = alive
     report.lost = lost
     if not lost:

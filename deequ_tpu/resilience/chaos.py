@@ -16,7 +16,13 @@ existing injector seam into one timeline —
   (``FaultSchedule.delay_seconds``);
 - ``fs``    — seeded I/O faults on the checkpoint filesystem
   (``FaultInjectingFileSystem``; the schedule's fs event also switches
-  the run to checkpointed mode so the persistence seam is in play) —
+  the run to checkpointed mode so the persistence seam is in play);
+- ``worker`` — fleet-tier worker faults (round 12): scripted
+  death / stall / rejoin of serving workers in a
+  :class:`~deequ_tpu.serve.fleet.VerificationFleet`. A schedule with
+  any worker event runs the FLEET scenario instead of the streaming
+  one: the same batch partition becomes per-tenant suites submitted in
+  waves to a 4-worker fleet, with the events applied between waves —
 
 run one governed verification under it (``on_batch_error="skip"``,
 ``on_device_error="fallback"``, a `RunPolicy` budget), and then check the
@@ -38,7 +44,16 @@ system's OWN cross-cutting invariants as oracles:
 7. ledger consistency — quarantined batches all trace to injected
    faults; the run budget's total equals the sum of its per-rung
    charges; its ``io_retry`` charges equal the run's retry-telemetry
-   attempts.
+   attempts;
+8. exactly-once futures (worker seam) — every future the fleet accepted
+   resolves exactly once (a result or a typed error): none orphaned by
+   a dead worker, none double-resolved by a stalled worker waking after
+   its requests failed over (``VerificationFuture.resolve_count``).
+
+Worker-seam schedules check oracles 1/2/3/5/8 (the streaming-specific
+row-accounting and fetch/ledger oracles have no fleet analogue — a
+tenant's suite either completes bit-identically after failover or
+rejects typed).
 
 A failing schedule is reduced by :func:`shrink_schedule` — classic
 delta debugging (ddmin) over the event list, re-running the oracles per
@@ -86,7 +101,30 @@ HANG_SECONDS = 0.6
 TERMINATION_SLACK = 2.0
 
 _SCAN_KINDS = ("oom", "compile", "lost", "hang")
-_SEAMS = ("scan", "batch", "staging", "fs")
+_SEAMS = ("scan", "batch", "staging", "fs", "worker")
+
+#: fleet scenario geometry (worker seam): the scenario table splits into
+#: one slice per tenant, each submitted once per wave; worker events
+#: apply WHILE a wave is in flight (submitted, not yet gathered), so
+#: "mid-load" is scripted, not racy. Slice sizes are deliberately
+#: UNEQUAL: the fleet routes by (schema, analyzers, rows), and equal
+#: slices would share one digest — every tenant on one worker, the
+#: other three untouchable by any schedule.
+FLEET_N_WORKERS = 4
+FLEET_WAVES = 3
+FLEET_TENANT_ROWS = (250, 350, 450, 550)  # sums to N_ROWS
+_WORKER_KINDS = ("death", "stall", "rejoin")
+
+#: fleet membership knobs for the scenario: a heartbeat probe every
+#: 50ms, a worker declared lost after 0.3s of silence
+FLEET_HEARTBEAT = 0.05
+FLEET_STALL_TIMEOUT = 0.3
+
+#: scripted worker stalls wedge the worker thread this long — longer
+#: than FLEET_STALL_TIMEOUT, so membership declares the worker lost and
+#: failover runs while it sleeps; when it wakes, its late resolutions
+#: are dropped (oracle 8 watches the count)
+WORKER_STALL_SECONDS = 0.8
 
 
 def _fast_retry():
@@ -225,6 +263,57 @@ class ChaosSchedule:
             on_budget_exhausted=(
                 "raise" if rng.random() < 0.15 else "degrade"
             ),
+        )
+
+    @staticmethod
+    def generate_worker(seed: int) -> "ChaosSchedule":
+        """Seeded WORKER-seam schedule (the fleet scenario): scripted
+        death / stall / rejoin events over the waves. Events are drawn
+        in wave order (application order), tracking which workers are
+        down so rejoins target actually-dead workers and at least one
+        survivor always remains — a zero-survivor fleet is a separate
+        typed-error path pinned by the fleet tests, not a fuzz target
+        (every schedule here must have somewhere to fail over TO)."""
+        rng = Random(seed)
+        events: List[dict] = []
+        down: set = set()
+        for wave in range(FLEET_WAVES):
+            if rng.random() >= 0.7 and events:
+                continue
+            up = [w for w in range(FLEET_N_WORKERS) if w not in down]
+            kinds = []
+            if len(up) > 1:
+                # death and stall both retire the worker (a scripted
+                # stall outlasts the membership timeout by design)
+                kinds += ["death", "death", "stall"]
+            if down:
+                kinds += ["rejoin", "rejoin"]
+            if not kinds:
+                continue
+            kind = rng.choice(kinds)
+            if kind == "rejoin":
+                worker = rng.choice(sorted(down))
+                down.discard(worker)
+            else:
+                worker = rng.choice(up)
+                down.add(worker)
+            events.append(
+                {
+                    "seam": "worker",
+                    "kind": kind,
+                    "worker": worker,
+                    "wave": wave,
+                }
+            )
+        if not events:
+            events.append(
+                {"seam": "worker", "kind": "death",
+                 "worker": rng.randrange(FLEET_N_WORKERS), "wave": 1}
+            )
+        # generous deadline: the fleet scenario pays per-worker program
+        # compiles (4 distinct tenant shapes) before steady state
+        return ChaosSchedule(
+            seed=seed, events=tuple(events), run_deadline=30.0,
         )
 
 
@@ -394,6 +483,10 @@ class ChaosReport:
     injected: List[tuple] = field(default_factory=list)
     resident_after: int = 0
     drifted: bool = False
+    #: worker-seam (fleet scenario) future accounting — oracle 8's
+    #: evidence: accepted / resolved-exactly-once / orphaned /
+    #: multi-resolved counts plus the dropped late resolutions
+    fleet: Dict[str, int] = field(default_factory=dict)
 
     @property
     def failing(self) -> bool:
@@ -433,6 +526,8 @@ def run_schedule(
 ) -> ChaosReport:
     """Run one schedule end to end: fault-free reference, chaos run under
     the composed injectors + run budget, then every invariant oracle.
+    A schedule with any ``worker`` event runs the FLEET scenario
+    (:func:`_run_worker_schedule`) instead of the streaming one.
 
     ``simulate_drift=True`` is the deliberately-broken-ladder mode: when
     any fault was injected, the run's successful metrics are perturbed
@@ -440,6 +535,8 @@ def run_schedule(
     recovery path that silently loses bit-identity — so the oracles (and
     the shrinker on top of them) can be shown to catch a real ladder
     regression."""
+    if any(e.get("seam") == "worker" for e in schedule.events):
+        return _run_worker_schedule(schedule, simulate_drift=simulate_drift)
     from deequ_tpu.data.source import TableBatchSource
     from deequ_tpu.data.streaming import StreamingTable
     from deequ_tpu.ops.device_policy import install_scan_fault_hook
@@ -597,6 +694,282 @@ def run_schedule(
 
     report.violations = _check_oracles(report, ref, exc, table)
     return report
+
+
+# -- the fleet scenario (worker seam) ----------------------------------------
+
+
+def _tenant_slices(table):
+    """The fleet scenario's tenants: the scenario table split into
+    ``FLEET_TENANT_ROWS``-sized slices (unequal on purpose — distinct
+    row counts give distinct routing digests, so the tenants spread
+    across the ring; see the geometry comment)."""
+    import numpy as np
+
+    out, lo = [], 0
+    for rows in FLEET_TENANT_ROWS:
+        idx = np.arange(lo, lo + rows)
+        out.append(
+            type(table)([table[c].take(idx) for c in table.column_names])
+        )
+        lo += rows
+    return out
+
+
+#: healthy per-tenant reference metrics, memoized across schedules (a
+#: pure function of the fixed scenario slice)
+_FLEET_REF_CACHE: Dict[int, Dict[str, tuple]] = {}
+
+
+def _fleet_reference(tenant: int, table) -> Dict[str, tuple]:
+    """Fault-free reference for one tenant: a direct per-tenant
+    ``VerificationSuite`` run under the single-device view — the serial
+    twin the serving layer's coalesced==serial contract (tier-1 `serve`)
+    already pins bit-identical, and the fleet's failover re-dispatch
+    must reproduce bit-for-bit (plans are deterministic)."""
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.resilience.governance import fault_state_scope
+    from deequ_tpu.verification import VerificationSuite
+
+    if tenant in _FLEET_REF_CACHE:
+        return _FLEET_REF_CACHE[tenant]
+    with fault_state_scope(), use_mesh(None):
+        result = VerificationSuite.do_verification_run(
+            table, [_check()], _analyzers()
+        )
+    out = _metric_rows(result)
+    _FLEET_REF_CACHE[tenant] = out
+    return out
+
+
+def _apply_worker_event(fleet, event: dict) -> None:
+    kind, worker = event["kind"], int(event["worker"])
+    if kind == "death":
+        fleet.kill_worker(worker, reason="chaos schedule")
+    elif kind == "stall":
+        fleet.stall_worker(worker, WORKER_STALL_SECONDS)
+    elif kind == "rejoin":
+        fleet.rejoin_worker(worker)
+    else:
+        raise ValueError(f"unknown worker event kind {kind!r}")
+
+
+def _run_worker_schedule(
+    schedule: ChaosSchedule, simulate_drift: bool = False
+) -> ChaosReport:
+    """The worker-seam scenario: ``FLEET_WAVES`` waves of per-tenant
+    suites over a ``FLEET_N_WORKERS`` fleet, the schedule's worker
+    events applied while their wave is in flight (submitted, not yet
+    gathered), then oracles 1/2/3 + fetch contract + 8 — the
+    streaming-specific row-accounting/ledger oracles have no fleet
+    analogue (a tenant's suite either completes bit-identically after
+    failover or rejects typed)."""
+    from deequ_tpu.obs.registry import REGISTRY
+    from deequ_tpu.serve.fleet import VerificationFleet
+
+    table = _build_table()
+    tenants = _tenant_slices(table)
+    ref = {t: _fleet_reference(t, tbl) for t, tbl in enumerate(tenants)}
+
+    by_wave: Dict[int, List[dict]] = {}
+    for e in schedule.events:
+        if e.get("seam") == "worker":
+            by_wave.setdefault(int(e.get("wave", 0)), []).append(e)
+
+    applied: List[tuple] = []
+    gathered: List[tuple] = []  # (wave, tenant, future)
+    exc: Optional[BaseException] = None
+    reg_before = REGISTRY.snapshot()
+    t0 = time.monotonic()
+    # the scenario fleet: shared-compile-cache workers (see
+    # FleetConfig.distinct_devices) so a steady-state dispatch is
+    # milliseconds and FLEET_STALL_TIMEOUT cleanly separates "busy"
+    # from "scripted stall"; the monitor arms only AFTER the warmup
+    # wave + prewarm below — cold compiles would otherwise read as
+    # stalls and every schedule would cascade into total fleet loss
+    fleet = VerificationFleet(
+        n_workers=FLEET_N_WORKERS,
+        heartbeat_interval=FLEET_HEARTBEAT,
+        stall_timeout=FLEET_STALL_TIMEOUT,
+        distinct_devices=False,
+        monitor=False,
+    )
+    try:
+        warmup = [
+            fleet.submit(
+                tbl, [_check()],
+                required_analyzers=_analyzers(), tenant=f"t{t}",
+            )
+            for t, tbl in enumerate(tenants)
+        ]
+        for future in warmup:
+            future.result(timeout=schedule.run_deadline)
+        fleet.prewarm()
+        fleet.membership.start()
+        for wave in range(FLEET_WAVES):
+            wave_futures = []
+            for t, tbl in enumerate(tenants):
+                future = fleet.submit(
+                    tbl, [_check()],
+                    required_analyzers=_analyzers(), tenant=f"t{t}",
+                )
+                wave_futures.append((t, future))
+            # the wave is in flight: apply this wave's scripted events
+            for e in by_wave.get(wave, ()):
+                _apply_worker_event(fleet, e)
+                applied.append(
+                    ("worker", e["kind"], int(e["worker"]), wave)
+                )
+            for t, future in wave_futures:
+                gathered.append((wave, t, future))
+                try:
+                    future.result(timeout=schedule.run_deadline)
+                # deequ-lint: ignore[bare-except] -- the chaos driver observes ANY per-future outcome; oracle 1 re-checks that it was typed
+                except Exception:  # noqa: BLE001
+                    pass
+    # deequ-lint: ignore[bare-except] -- a submit on an all-dead fleet (or any driver error) becomes the report's outcome; oracle 1 checks it is typed
+    except Exception as e:  # noqa: BLE001
+        exc = e
+    finally:
+        fleet.stop(drain=True)
+    elapsed = time.monotonic() - t0
+    reg_after = REGISTRY.snapshot()
+
+    metrics: Dict[str, tuple] = {}
+    for wave, t, future in gathered:
+        prefix = f"w{wave}/t{t}"
+        if future._error is not None:
+            metrics[prefix] = ("fail", type(future._error).__name__)
+        elif future._result is not None:
+            for name, row in _metric_rows(future._result).items():
+                metrics[f"{prefix}/{name}"] = row
+    rejected = sum(
+        1 for _, _, f in gathered if f.done() and f._error is not None
+    )
+    scan_before, scan_after = reg_before["scan"], reg_after["scan"]
+    report = ChaosReport(
+        schedule=schedule,
+        outcome=(
+            f"exception:{type(exc).__name__}" if exc is not None
+            else ("degraded" if rejected else "identical")
+        ),
+        elapsed=elapsed,
+        metrics=metrics,
+        scan_delta={
+            k: scan_after[k] - scan_before[k]
+            for k in ("scan_passes", "device_fetches")
+        },
+        injected=applied,
+        fleet={
+            "accepted": len(gathered),
+            "resolved_once": sum(
+                1 for _, _, f in gathered
+                if f.done() and f.resolve_count == 1
+            ),
+            "orphaned": sum(1 for _, _, f in gathered if not f.done()),
+            "multi_resolved": sum(
+                1 for _, _, f in gathered if f.resolve_count > 1
+            ),
+            "late_resolutions": sum(
+                f.late_resolutions for _, _, f in gathered
+            ),
+            "rejected": rejected,
+            "workers_lost": fleet.workers_lost,
+            "requests_redispatched": fleet.requests_redispatched,
+        },
+    )
+
+    if simulate_drift and applied and report.metrics:
+        report.drifted = True
+        report.metrics = {
+            k: ("ok", v + 1e-9) if status == "ok" else (status, v)
+            for k, (status, v) in report.metrics.items()
+        }
+
+    report.violations = _check_worker_oracles(report, ref, exc)
+    return report
+
+
+def _check_worker_oracles(
+    report: ChaosReport, ref: Dict[int, Dict[str, tuple]], exc
+) -> List[str]:
+    """The worker-seam oracle subset (see the module docstring)."""
+    from deequ_tpu.exceptions import MetricCalculationException
+
+    v: List[str] = []
+    schedule = report.schedule
+
+    # 1. typed outcome — the driver-level exception AND every rejected
+    # future must come from the taxonomy
+    if exc is not None and not isinstance(exc, MetricCalculationException):
+        v.append(f"untyped outcome: {type(exc).__name__}: {exc}")
+    for key, row in report.metrics.items():
+        if row[0] == "fail" and not (
+            row[1].endswith("Exception") or row[1].endswith("Error")
+        ):
+            v.append(f"future {key}: suspicious failure type {row[1]}")
+
+    # 2. termination
+    if report.elapsed > schedule.run_deadline * 1.5 + TERMINATION_SLACK:
+        v.append(
+            f"termination: {report.elapsed:.2f}s exceeded "
+            f"run_deadline={schedule.run_deadline:g}s (+slack)"
+        )
+
+    # 8. exactly-once futures: every accepted future resolves exactly
+    # once — none orphaned by a dead worker, none double-resolved by a
+    # stalled worker waking after failover
+    fl = report.fleet
+    if fl.get("orphaned"):
+        v.append(
+            f"exactly-once: {fl['orphaned']} of {fl['accepted']} accepted "
+            "futures never resolved (orphaned by a lost worker)"
+        )
+    if fl.get("multi_resolved"):
+        v.append(
+            f"exactly-once: {fl['multi_resolved']} futures applied more "
+            "than one resolution"
+        )
+    if fl.get("resolved_once", 0) + fl.get("orphaned", 0) != fl.get(
+        "accepted", 0
+    ):
+        v.append(
+            "exactly-once: resolved_once + orphaned != accepted "
+            f"({fl})"
+        )
+
+    # fetch contract: the serving path's one-fetch-per-coalesced-batch
+    # discipline bounds fetches by scan passes, failover included
+    if report.scan_delta.get("device_fetches", 0) > report.scan_delta.get(
+        "scan_passes", 0
+    ):
+        v.append(
+            "fetch contract: "
+            f"{report.scan_delta['device_fetches']} fetches > "
+            f"{report.scan_delta['scan_passes']} scan passes"
+        )
+
+    # 3. bit-identity: every future that resolved with a result must
+    # equal the tenant's healthy serial reference bit for bit —
+    # re-dispatched or not (plans are deterministic)
+    for key, (status, value) in report.metrics.items():
+        if status != "ok":
+            continue
+        _, t_part, name = key.split("/", 2)
+        exp = ref[int(t_part[1:])].get(name)
+        if exp is None:
+            v.append(f"metric {key}: no reference value")
+        elif exp[0] != "ok":
+            v.append(
+                f"metric {key}: reference failed ({exp[1]}) but fleet "
+                "run succeeded"
+            )
+        elif not _bit_identical(value, exp[1]):
+            v.append(
+                f"metric {key}: {value!r} != healthy reference "
+                f"{exp[1]!r} (failover must be bit-identical)"
+            )
+    return v
 
 
 # -- oracles -----------------------------------------------------------------
@@ -844,17 +1217,23 @@ def soak(
     seed0: int = 0,
     simulate_drift: bool = False,
     verbose: bool = True,
+    worker: bool = False,
 ) -> dict:
     """Run ``n`` seeded schedules; returns a summary with every failing
     seed and its shrunk reproducer. The CI entry point
-    (``python -m deequ_tpu.resilience.chaos --soak``)."""
+    (``python -m deequ_tpu.resilience.chaos --soak``); ``worker=True``
+    (CLI ``--worker``) soaks worker-seam schedules over the fleet
+    scenario instead of the streaming one."""
     import sys
 
     outcomes: Dict[str, int] = {}
     failures = []
     t0 = time.monotonic()
+    generate = (
+        ChaosSchedule.generate_worker if worker else ChaosSchedule.generate
+    )
     for seed in range(seed0, seed0 + n):
-        schedule = ChaosSchedule.generate(seed)
+        schedule = generate(seed)
         report = run_schedule(schedule, simulate_drift=simulate_drift)
         outcomes[report.outcome] = outcomes.get(report.outcome, 0) + 1
         if report.failing:
@@ -909,6 +1288,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--replay", type=str, default=None,
         help="replay one schedule fixture (JSON path)",
     )
+    parser.add_argument(
+        "--worker", action="store_true",
+        help="soak worker-seam schedules (fleet scenario: scripted "
+        "worker death/stall/rejoin under oracles 1/2/3/fetch/8)",
+    )
     args = parser.parse_args(argv)
 
     if args.replay:
@@ -928,7 +1312,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1 if report.failing else 0
 
     n = args.n if args.soak else 20
-    summary = soak(n=n, seed0=args.seed, simulate_drift=args.drift_sim)
+    summary = soak(
+        n=n, seed0=args.seed, simulate_drift=args.drift_sim,
+        worker=args.worker,
+    )
     print(json.dumps(summary, indent=2, default=str))
     if args.drift_sim:
         # self-test mode: every schedule that injected something must

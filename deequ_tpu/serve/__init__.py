@@ -37,18 +37,29 @@ See docs/serving.md for cache-key semantics, coalescing/padding rules,
 and the isolation ladder.
 """
 
+from deequ_tpu.serve.fleet import FleetConfig, VerificationFleet
+from deequ_tpu.serve.membership import FleetMembership, WorkerLossReport
 from deequ_tpu.serve.plan_cache import PlanCache, PlanKey, ServePlan
+from deequ_tpu.serve.router import ConsistentHashRouter, route_digest
 from deequ_tpu.serve.service import (
+    PendingWork,
     ServeConfig,
     VerificationFuture,
     VerificationService,
 )
 
 __all__ = [
+    "ConsistentHashRouter",
+    "FleetConfig",
+    "FleetMembership",
+    "PendingWork",
     "PlanCache",
     "PlanKey",
+    "route_digest",
     "ServePlan",
     "ServeConfig",
+    "VerificationFleet",
     "VerificationFuture",
     "VerificationService",
+    "WorkerLossReport",
 ]

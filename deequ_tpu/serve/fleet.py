@@ -1,0 +1,708 @@
+"""VerificationFleet — N serving workers with failover, one quarantine.
+
+PR 10's :class:`~deequ_tpu.serve.service.VerificationService` is one
+worker on one device: it dies with its thread, its plan cache dies with
+it, and its quarantine ledger is private. This module is the fleet tier
+(ROADMAP item 1 — the remaining gap between "a serving layer" and
+"serves millions of users"):
+
+- **Placement** — tenants route by consistent hash of the admission-free
+  plan fingerprint (:mod:`deequ_tpu.serve.router`): plan-cache locality
+  survives worker join/leave, so failover never pays a fleet-wide
+  recompilation storm (the Flare locality argument, arXiv:1703.08219).
+- **Membership** — heartbeat-driven (:mod:`deequ_tpu.serve.membership`,
+  the ``check_peers`` probe seam applied in-process): a worker whose
+  service thread dies or stalls past ``stall_timeout`` is declared lost
+  (typed :class:`~deequ_tpu.exceptions.WorkerLostException`) by a
+  background monitor — no human in the loop.
+- **Failover** — the lost worker's accepted-but-unresolved requests
+  (queued AND in-flight; the fleet ledger is authoritative) re-dispatch
+  onto survivors on their ORIGINAL futures — ``stop(drain=False)`` /
+  ``resume`` kill-and-resume semantics lifted from one service to the
+  fleet. Plans are deterministic, so a re-dispatched result is
+  bit-identical to what the dead worker would have produced; if the
+  presumed-dead worker was merely stalled and wakes to resolve late,
+  the futures' first-resolution-wins gate drops the duplicate — every
+  accepted future resolves exactly once (chaos oracle 8).
+- **No free retries** — a tenant's :class:`RunBudget` is armed ONCE at
+  fleet submit and FOLLOWS the request: every failover re-dispatch
+  charges it (kind ``worker_failover``), so a request cannot ride
+  worker deaths to unlimited attempts; exhaustion degrades or rejects
+  exactly as the single-service ladder does.
+- **Cross-worker quarantine** — all workers share ONE ``_TenantHealth``
+  ledger: a poison tenant quarantined by any worker is serial-only
+  fleet-wide, and one success anywhere heals it fleet-wide.
+- **Warm join** — a (re)joining worker imports the survivors' hot plans
+  (the plan cache's LRU recency feed, surfaced through the obs registry
+  as ``fleet.hot_plans``) BEFORE it is admitted to the ring, so its
+  first requests hit warm state instead of paying trace storms.
+
+Chaos seams: :meth:`kill_worker` (scripted death),
+:meth:`stall_worker` (the service's ``inject_stall``), and
+:meth:`rejoin_worker` — the ``worker`` seam
+``resilience/chaos.py`` scripts under its invariant oracles.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from deequ_tpu.exceptions import (
+    RunBudgetExhaustedException,
+    ServiceClosedException,
+    WorkerLostException,
+)
+from deequ_tpu.serve.membership import FleetMembership
+from deequ_tpu.serve.router import ConsistentHashRouter, route_digest
+from deequ_tpu.serve.service import (
+    ServeConfig,
+    ServeRequest,
+    VerificationService,
+    _TenantHealth,
+)
+
+
+class _PreArmedPolicy:
+    """RunPolicy stand-in whose ``arm()`` returns the SAME armed budget
+    every time: the mechanism that makes a tenant's budget FOLLOW its
+    request across failover re-dispatch (a fresh worker calling
+    ``run_policy.arm()`` must not mint a fresh ledger)."""
+
+    def __init__(self, budget):
+        self.budget = budget
+
+    def arm(self):
+        return self.budget
+
+
+@dataclass
+class FleetConfig:
+    """Fleet knobs. ``n_workers`` / ``heartbeat_interval`` /
+    ``failover_retries`` default from DEEQU_TPU_FLEET_WORKERS /
+    DEEQU_TPU_HEARTBEAT_INTERVAL / DEEQU_TPU_FAILOVER_RETRIES (envcfg
+    registry — typed ``EnvConfigError`` on garbage). ``worker_knobs``
+    feed each worker's :class:`ServeConfig`; ``stall_timeout`` defaults
+    to ``max(8 * heartbeat_interval, 2s)`` — generous enough that a
+    busy batch is not a false positive; ``warm_plans`` bounds the
+    hot-plan transfer per donor on worker join."""
+
+    n_workers: Optional[int] = None
+    heartbeat_interval: Optional[float] = None
+    stall_timeout: Optional[float] = None
+    failover_retries: Optional[int] = None
+    warm_plans: int = 8
+    monitor: bool = True
+    quarantine_after: int = 2
+    run_policy: Any = None
+    worker_knobs: Optional[Dict[str, Any]] = None
+    #: True (production shape) pins worker i to device i — fleet
+    #: parallelism across chips, but a failover target pays one
+    #: per-device compile for each migrated plan (jit executables are
+    #: device-committed; transferred cache entries re-lower). False runs
+    #: every worker on the ambient device with a SHARED compile cache —
+    #: failover is warm immediately, which is what latency-sensitive
+    #: single-chip deployments (and the deterministic chaos scenario,
+    #: whose stall timeout must sit BELOW the scripted stall but ABOVE
+    #: a steady-state dispatch) want.
+    distinct_devices: bool = True
+
+    def __post_init__(self):
+        from deequ_tpu.envcfg import env_value
+
+        if self.heartbeat_interval is None:
+            self.heartbeat_interval = env_value(
+                "DEEQU_TPU_HEARTBEAT_INTERVAL"
+            )
+        self.heartbeat_interval = float(self.heartbeat_interval)
+        if self.heartbeat_interval <= 0:
+            raise ValueError("heartbeat_interval must be > 0 seconds")
+        if self.failover_retries is None:
+            self.failover_retries = env_value("DEEQU_TPU_FAILOVER_RETRIES")
+        self.failover_retries = int(self.failover_retries)
+        if self.failover_retries < 0:
+            raise ValueError("failover_retries must be >= 0")
+        if self.n_workers is None:
+            self.n_workers = env_value("DEEQU_TPU_FLEET_WORKERS")
+        if self.n_workers is not None and int(self.n_workers) < 1:
+            raise ValueError("n_workers must be >= 1")
+        if self.stall_timeout is None:
+            self.stall_timeout = max(8 * self.heartbeat_interval, 2.0)
+        self.stall_timeout = float(self.stall_timeout)
+        if self.warm_plans < 0:
+            raise ValueError("warm_plans must be >= 0")
+        self.worker_knobs = dict(self.worker_knobs or {})
+
+
+class FleetWorker:
+    """One fleet member: a :class:`VerificationService` pinned to a
+    device, plus liveness state. ``alive=False`` workers stay in the
+    table (their id can rejoin) but own no ring arcs."""
+
+    def __init__(self, idx: int, service: VerificationService, device):
+        self.idx = idx
+        self.service = service
+        self.device = device
+        self.alive = True
+
+    def queue_depth(self) -> int:
+        try:
+            return self.service.pending_count()
+        except ServiceClosedException:
+            return 0
+
+
+@dataclass
+class _Assignment:
+    """The fleet's authoritative record of one accepted request — what
+    failover re-dispatches when its worker dies (queued or in-flight
+    alike; the dead worker's internal queue is NOT consulted)."""
+
+    data: Any
+    checks: tuple
+    required_analyzers: tuple
+    tenant: Any
+    budget: Any            # armed RunBudget (None = ungoverned)
+    digest: str
+    worker: int
+    failovers: int = 0
+
+
+#: the most recent fleet, for the obs registry's read-through section
+_ACTIVE_FLEET: Optional[weakref.ReferenceType] = None
+
+
+def _fleet_section() -> dict:
+    """The obs registry's ``fleet`` collector: workers alive, per-worker
+    queue depth, failover count, and the hot-plan feed worker-join
+    warmup draws from."""
+    from deequ_tpu.obs.registry import FLEET_FAILOVERS
+
+    fleet = _ACTIVE_FLEET() if _ACTIVE_FLEET is not None else None
+    if fleet is None:
+        return {"workers_alive": 0, "failovers": FLEET_FAILOVERS.value}
+    return fleet._section()
+
+
+class VerificationFleet:
+    """The multi-worker serving entry point (see module doc)."""
+
+    def __init__(self, config: Optional[FleetConfig] = None,
+                 start: bool = True, trace=None, **knobs):
+        global _ACTIVE_FLEET
+        import jax
+
+        self.config = config if config is not None else FleetConfig(**knobs)
+        self._trace = trace
+        self._devices = list(jax.devices())
+        n = self.config.n_workers
+        if n is None:
+            n = min(4, max(1, len(self._devices)))
+        self.n_workers = int(n)
+        # ONE quarantine ledger for the whole fleet (cross-worker
+        # quarantine: poison isolated everywhere, healed everywhere)
+        self._tenant_health = _TenantHealth(self.config.quarantine_after)
+        self._router = ConsistentHashRouter()
+        self._workers: Dict[int, FleetWorker] = {}
+        self._zombies: List[VerificationService] = []
+        self._assignments: Dict[Any, _Assignment] = {}
+        self._heat: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        # serializes loss handling AND submission against it: the
+        # membership monitor, kill_worker, and rejoin_worker must not
+        # interleave membership mutations — and a submit must fully
+        # record its assignment before a loss snapshot runs, or a
+        # monitor firing between enqueue and record would orphan the
+        # future (its request cleared with the dead queue, its
+        # assignment invisible to the victim sweep). Reentrant: a
+        # submit that discovers a dead service retires it inline.
+        self._failover_lock = threading.RLock()
+        self._closed = False
+        self.workers_lost = 0
+        self.requests_redispatched = 0
+        self.membership = FleetMembership(
+            members=self._alive_ids,
+            probe_of=self._probe_worker,
+            on_loss=self._handle_loss,
+            interval=self.config.heartbeat_interval,
+            stall_timeout=self.config.stall_timeout,
+        )
+        for idx in range(self.n_workers):
+            service = self._spawn_service(idx)
+            self._workers[idx] = FleetWorker(
+                idx, service, self._device_for(idx)
+            )
+            self._router.add_worker(idx)
+        _ACTIVE_FLEET = weakref.ref(self)
+        from deequ_tpu.obs.registry import REGISTRY
+
+        REGISTRY.register_collector("fleet", _fleet_section)
+        self._update_alive_gauge()
+        if start and self.config.monitor:
+            self.membership.start()
+
+    # -- worker lifecycle ------------------------------------------------
+
+    def _device_for(self, idx: int):
+        if not self.config.distinct_devices or not self._devices:
+            return None
+        return self._devices[idx % len(self._devices)]
+
+    def _spawn_service(self, idx: int) -> VerificationService:
+        from deequ_tpu.parallel.mesh import use_mesh
+
+        knobs = dict(self.config.worker_knobs)
+        # each worker IS one device: construct under the single-device
+        # view (not the caller's ambient mesh) so workers coalesce on
+        # their own chip — fleet parallelism comes from placement across
+        # workers, not from sharding one suite across chips
+        with use_mesh(None):
+            return VerificationService(
+                config=ServeConfig(**knobs) if knobs else ServeConfig(),
+                start=True,
+                trace=self._trace,
+                device=self._device_for(idx),
+                tenant_health=self._tenant_health,
+            )
+
+    def _alive_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(i for i, w in self._workers.items() if w.alive)
+
+    def _probe_worker(self, idx: int):
+        with self._lock:
+            worker = self._workers.get(idx)
+        if worker is None:
+            return False, 0.0
+        thread = worker.service._thread
+        return (
+            thread is not None and thread.is_alive()
+            and not worker.service._closed,
+            worker.service.heartbeat,
+        )
+
+    def rejoin_worker(self, idx: int) -> Optional[FleetWorker]:
+        """Bring a lost worker id back: a FRESH service, warmed from the
+        survivors' hot plans BEFORE it owns any ring arc (a cold joiner
+        admitted immediately would eat trace storms exactly when the
+        fleet is already degraded)."""
+        with self._failover_lock:
+            with self._lock:
+                if self._closed:
+                    raise ServiceClosedException("fleet is stopped")
+                existing = self._workers.get(idx)
+                if existing is not None and existing.alive:
+                    return existing
+                donors = [
+                    w.service for w in self._workers.values() if w.alive
+                ]
+            service = self._spawn_service(idx)
+            self._warm(service, donors)
+            worker = FleetWorker(idx, service, self._device_for(idx))
+            with self._lock:
+                self._workers[idx] = worker
+                self._router.add_worker(idx)
+            self._update_alive_gauge()
+            from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+            SCAN_STATS.record_degradation(
+                "worker_rejoin", worker=idx,
+                warmed_plans=len(service.plan_cache),
+            )
+            return worker
+
+    def prewarm(self) -> None:
+        """Cross-transfer every alive worker's hot plans to every other
+        worker. After a prewarm, ANY survivor already holds a dead
+        worker's plans, so failover re-dispatch skips the plan build —
+        the fleet analogue of warming a cache tier before admitting
+        traffic. (On ``distinct_devices`` fleets the migrated programs
+        still re-lower once per new device; see :class:`FleetConfig`.)"""
+        with self._lock:
+            alive = [w for w in self._workers.values() if w.alive]
+        for worker in alive:
+            self._warm(
+                worker.service,
+                [d.service for d in alive if d is not worker],
+            )
+
+    def _warm(self, service: VerificationService, donors) -> None:
+        """Plan-cache warmup/transfer: import each donor's hottest
+        ``warm_plans`` entries (LRU recency — the registry's hot-plan
+        feed) plus the analyzer-family admission cache, so repeat
+        tenants landing on the joiner go straight to cached programs."""
+        for donor in donors:
+            try:
+                plans, families = donor.warm_state(self.config.warm_plans)
+                service.warm_from(plans, families)
+            # deequ-lint: ignore[bare-except] -- best-effort warmup over a possibly-concurrently-mutating donor cache: a failed transfer leaves the joiner cold, never broken
+            except Exception:  # noqa: BLE001
+                continue
+
+    #: heat-ledger bound: past this many distinct routing digests the
+    #: coldest half is dropped (the hot-plan feed only ever reads the
+    #: top ``warm_plans``; an unbounded dict would leak one entry per
+    #: distinct (schema, analyzers, rows) tuple for the fleet's life)
+    _HEAT_CAP = 1024
+
+    def _record_heat(self, digest: str) -> None:
+        """Caller holds ``self._lock``."""
+        self._heat[digest] = self._heat.get(digest, 0) + 1
+        if len(self._heat) > self._HEAT_CAP:
+            keep = sorted(
+                self._heat.items(), key=lambda kv: kv[1], reverse=True
+            )[: self._HEAT_CAP // 2]
+            self._heat = dict(keep)
+
+    # -- submission ------------------------------------------------------
+
+    def route(self, data, checks: Sequence = (),
+              required_analyzers: Sequence = ()) -> Optional[int]:
+        """The worker id a submission would land on (tests/bench use
+        this to script deterministic deaths)."""
+        analyzers = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+        return self._router.place(route_digest(data, analyzers))
+
+    def submit(
+        self,
+        data,
+        checks: Sequence = (),
+        required_analyzers: Sequence = (),
+        tenant=None,
+        run_policy=None,
+    ):
+        """Enqueue one suite on its placed worker; returns the future.
+        The tenant's budget (``run_policy`` or the fleet default) is
+        armed HERE — queue wait, execution, and any failover re-dispatch
+        all draw on the one ledger."""
+        analyzers = list(required_analyzers)
+        for check in checks:
+            analyzers.extend(check.required_analyzers())
+        digest = route_digest(data, analyzers)
+        policy = (
+            run_policy if run_policy is not None
+            else self.config.run_policy
+        )
+        budget = policy.arm() if policy is not None else None
+        with self._failover_lock:
+            with self._lock:
+                if self._closed:
+                    raise ServiceClosedException(
+                        "submit on a stopped VerificationFleet"
+                    )
+                self._record_heat(digest)
+                n_candidates = len(self._workers)
+            future = None
+            for _ in range(n_candidates + 1):
+                with self._lock:
+                    wid = self._router.place(digest)
+                    worker = (
+                        self._workers.get(wid) if wid is not None else None
+                    )
+                if worker is None:
+                    raise ServiceClosedException(
+                        "no alive workers in the fleet (all lost; "
+                        "rejoin_worker or restart)"
+                    )
+                try:
+                    future = worker.service.submit(
+                        data,
+                        checks=checks,
+                        required_analyzers=required_analyzers,
+                        tenant=tenant,
+                        run_policy=(
+                            _PreArmedPolicy(budget)
+                            if budget is not None else None
+                        ),
+                    )
+                    break
+                except ServiceClosedException:
+                    # the placed worker's service died between placement
+                    # and enqueue (thread crash not yet declared):
+                    # retire it — its ring arcs leave with it — and
+                    # place again on the survivors (reentrant lock)
+                    self._handle_loss(wid, WorkerLostException(
+                        f"worker {wid} service closed at submit",
+                        worker_ids=(wid,),
+                    ))
+            if future is None:
+                raise ServiceClosedException(
+                    "no alive workers in the fleet (all lost; "
+                    "rejoin_worker or restart)"
+                )
+            asg = _Assignment(
+                data=data,
+                checks=tuple(checks),
+                required_analyzers=tuple(required_analyzers),
+                tenant=tenant,
+                budget=budget,
+                digest=digest,
+                worker=worker.idx,
+            )
+            with self._lock:
+                self._assignments[future] = asg
+        self._chain_done(future)
+        return future
+
+    def verify(self, data, checks: Sequence = (), **kw):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(data, checks, **kw).result()
+
+    def _chain_done(self, future) -> None:
+        """Wrap the service's observation seam so the fleet ledger drops
+        the assignment the moment its future resolves (the service's
+        own histogram/trace callback still runs first)."""
+        prev = future._on_done
+
+        def _done(f, ok, _prev=prev):
+            if _prev is not None:
+                _prev(f, ok)
+            with self._lock:
+                self._assignments.pop(f, None)
+
+        future._on_done = _done
+        if future.done():
+            # resolved between submit and chaining: the callback already
+            # fired on the unwrapped seam — clean the ledger directly
+            with self._lock:
+                self._assignments.pop(future, None)
+
+    # -- failover --------------------------------------------------------
+
+    def kill_worker(self, idx: int, reason: str = "scripted death") -> int:
+        """Chaos/ops seam: simulate process death of worker ``idx`` and
+        fail its accepted requests over. Returns how many requests were
+        re-dispatched."""
+        return self._handle_loss(
+            idx,
+            WorkerLostException(
+                f"worker {idx} died: {reason}", worker_ids=(idx,)
+            ),
+        )
+
+    def stall_worker(self, idx: int, seconds: float) -> None:
+        """Chaos seam: wedge worker ``idx``'s thread for ``seconds``.
+        Past ``stall_timeout`` the membership monitor declares it lost
+        and failover runs; if the stall ends first, nothing happens —
+        exactly a real transient stall."""
+        with self._lock:
+            worker = self._workers.get(idx)
+        if worker is not None and worker.alive:
+            worker.service.inject_stall(seconds)
+
+    def _handle_loss(self, idx: int, cause: WorkerLostException) -> int:
+        """Membership's loss callback AND kill_worker's body: retire the
+        worker, then replay its unresolved assignments onto survivors
+        on their original futures."""
+        with self._failover_lock:
+            with self._lock:
+                worker = self._workers.get(idx)
+                if worker is None or not worker.alive or self._closed:
+                    return 0
+                worker.alive = False
+                self._router.remove_worker(idx)
+                self.workers_lost += 1
+                # keep the zombie service for fleet stop(): a stalled
+                # thread may still wake and must be shut down then (its
+                # late resolutions are dropped by the futures' gate)
+                self._zombies.append(worker.service)
+            # halt the service without joining: a stalled/dead thread
+            # cannot be joined, and simulated process death must not
+            # block failover behind it
+            worker.service.stop(drain=False, join=False)
+            self._update_alive_gauge()
+            from deequ_tpu.obs.registry import FLEET_FAILOVERS
+            from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+            FLEET_FAILOVERS.inc()
+            with self._lock:
+                victims = [
+                    (f, a) for f, a in self._assignments.items()
+                    if a.worker == idx and not f.done()
+                ]
+            SCAN_STATS.record_degradation(
+                "worker_failover", worker=idx, tenants=len(victims),
+                error=str(cause),
+            )
+            redispatched = 0
+            for future, asg in victims:
+                redispatched += self._redispatch(future, asg, idx, cause)
+            self.requests_redispatched += redispatched
+            return redispatched
+
+    def _redispatch(self, future, asg: _Assignment, lost_idx: int,
+                    cause: WorkerLostException) -> int:
+        """Replay ONE assignment onto a survivor (original future).
+        Charges the tenant's budget first — no free retries — and
+        rejects typed when retries/survivors run out."""
+        asg.failovers += 1
+        if asg.budget is not None:
+            try:
+                asg.budget.charge(
+                    "worker_failover", worker=lost_idx, tenant=asg.tenant,
+                )
+            except RunBudgetExhaustedException as exhausted:
+                self._finalize_budget_exhausted(future, asg, exhausted)
+                return 0
+        with self._lock:
+            wid = self._router.place(asg.digest)
+            target = self._workers.get(wid) if wid is not None else None
+        if target is None or asg.failovers > self.config.failover_retries:
+            future._reject(WorkerLostException(
+                f"request for tenant {asg.tenant!r} lost worker "
+                f"{lost_idx} and "
+                + ("no survivor remains"
+                   if target is None
+                   else f"exhausted failover_retries="
+                        f"{self.config.failover_retries}"),
+                worker_ids=cause.worker_ids,
+            ))
+            return 0
+        req = ServeRequest(
+            data=asg.data,
+            checks=asg.checks,
+            required_analyzers=asg.required_analyzers,
+            tenant=asg.tenant,
+            run_policy=(
+                _PreArmedPolicy(asg.budget)
+                if asg.budget is not None else None
+            ),
+            future=future,
+        )
+        try:
+            target.service.resume([req])
+        except ServiceClosedException as e:
+            # the survivor died between placement and resume (cascading
+            # loss): its own loss handling will replay this assignment
+            # again if the monitor catches it first; otherwise reject
+            # typed rather than strand the future
+            future._reject(WorkerLostException(
+                f"failover target worker {target.idx} already closed: {e}",
+                worker_ids=(lost_idx, target.idx),
+            ))
+            return 0
+        asg.worker = target.idx
+        self._chain_done(future)  # resume() rebound the observation seam
+        return 1
+
+    def _finalize_budget_exhausted(self, future, asg: _Assignment,
+                                   exhausted: RunBudgetExhaustedException
+                                   ) -> None:
+        """A failover charge exhausted the tenant's budget: degrade this
+        one request (typed failure metrics + ledger) or reject typed —
+        the single-service exhaustion semantics, applied at the fleet
+        seam."""
+        from deequ_tpu.analyzers.runner import AnalyzerContext
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.verification import VerificationSuite, _dedup_analyzers
+
+        SCAN_STATS.record_degradation(
+            "tenant_budget_exhausted", tenant=asg.tenant,
+            reason=exhausted.reason,
+        )
+        if self._tenant_health.record_failure(asg.tenant):
+            SCAN_STATS.record_degradation(
+                "tenant_quarantine", tenant=asg.tenant,
+                consecutive=self._tenant_health.failures.get(asg.tenant),
+            )
+        if not exhausted.degraded:
+            future._reject(exhausted)
+            return
+        analyzers = list(asg.required_analyzers)
+        for check in asg.checks:
+            analyzers.extend(check.required_analyzers())
+        ctx = AnalyzerContext({
+            a: a.to_failure_metric(exhausted)
+            for a in _dedup_analyzers(analyzers)
+        })
+        result = VerificationSuite._evaluate(asg.checks, ctx)
+        result.scan_stats = {"coalesced": False, "failed": str(exhausted)}
+        result.run_budget = asg.budget.snapshot()
+        future._resolve(result)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        with self._lock:
+            services = [
+                w.service for w in self._workers.values() if w.alive
+            ]
+        for service in services:
+            service.flush(timeout)
+
+    def stop(self, drain: bool = True) -> List:
+        """Stop the whole fleet. ``drain=True`` serves every queued
+        request first; returns the futures still unresolved (empty
+        after a drain)."""
+        self.membership.stop()
+        with self._lock:
+            self._closed = True
+            services = [
+                w.service for w in self._workers.values() if w.alive
+            ]
+            zombies = list(self._zombies)
+        for service in services:
+            service.stop(drain=drain)
+        for zombie in zombies:
+            zombie.stop(drain=False, join=False)
+        self._update_alive_gauge(0)
+        with self._lock:
+            leftovers = [
+                f for f in self._assignments if not f.done()
+            ]
+        return leftovers
+
+    def __enter__(self) -> "VerificationFleet":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(drain=exc_type is None)
+
+    # -- introspection ---------------------------------------------------
+
+    def _update_alive_gauge(self, value: Optional[int] = None) -> None:
+        from deequ_tpu.obs.registry import FLEET_WORKERS_ALIVE
+
+        FLEET_WORKERS_ALIVE.set(
+            value if value is not None else len(self._alive_ids())
+        )
+
+    def _section(self) -> dict:
+        """The registry's ``fleet`` section (see ``_fleet_section``)."""
+        from deequ_tpu.obs.registry import FLEET_FAILOVERS
+
+        with self._lock:
+            workers = {
+                str(i): {
+                    "alive": w.alive,
+                    "queue_depth": w.queue_depth() if w.alive else 0,
+                    "suites_served": w.service.suites_served,
+                }
+                for i, w in self._workers.items()
+            }
+            hot = sorted(
+                self._heat.items(), key=lambda kv: kv[1], reverse=True
+            )[:self.config.warm_plans]
+            pending = sum(
+                1 for f in self._assignments if not f.done()
+            )
+        return {
+            "workers_alive": sum(
+                1 for w in workers.values() if w["alive"]
+            ),
+            "workers_lost": self.workers_lost,
+            "failovers": FLEET_FAILOVERS.value,
+            "requests_redispatched": self.requests_redispatched,
+            "requests_outstanding": pending,
+            "workers": workers,
+            "hot_plans": [
+                {"digest": d[:12], "heat": n} for d, n in hot
+            ],
+        }
+
+    def stats(self) -> dict:
+        return self._section()

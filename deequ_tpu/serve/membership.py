@@ -1,0 +1,197 @@
+"""Heartbeat-driven fleet membership — the ``check_peers`` seam applied
+in-process.
+
+The multi-host tier already solved worker liveness once: ``check_peers``
+(parallel/distributed.py) runs an INJECTABLE probe — ``probe(timeout) ->
+responsive member ids`` — attributes the losses, and either raises typed
+or returns a degradation report. The fleet reuses that exact seam
+(:func:`~deequ_tpu.parallel.distributed.probe_liveness` is the factored-
+out attribution step) with a different default probe: instead of
+barriers over the jax.distributed KV store, each worker's liveness is
+its service thread being alive AND its ``heartbeat`` (bumped every
+worker-loop iteration) being fresher than ``stall_timeout``. A worker
+wedged inside a dispatch looks exactly like a dead one — which is the
+point: both stop serving their queue, both need failover.
+
+Losses surface as typed
+:class:`~deequ_tpu.exceptions.WorkerLostException` (the fleet analogue
+of ``PeerLostException``, same ``DeviceException`` taxonomy) or, with
+``on_worker_loss="degrade"``, as a :class:`WorkerLossReport` the fleet's
+failover path consumes. A background monitor thread polls every
+``interval`` seconds (``DEEQU_TPU_HEARTBEAT_INTERVAL``) and invokes the
+fleet's loss callback — heartbeat-driven membership, no human in the
+loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from deequ_tpu.exceptions import WorkerLostException
+from deequ_tpu.parallel.distributed import probe_liveness
+
+
+@dataclass
+class WorkerLossReport:
+    """The outcome of one fleet liveness check (mirrors
+    ``PeerLossReport``): ``lost`` names the worker ids that stopped
+    responding; ``surviving`` the rest."""
+
+    n_workers: int
+    surviving: List[int] = field(default_factory=list)
+    lost: List[int] = field(default_factory=list)
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.lost)
+
+
+class FleetMembership:
+    """Liveness tracking over one fleet's workers (see module doc).
+
+    ``members()`` yields the worker ids currently expected alive;
+    ``probe_of(worker_id)`` returns (thread_alive, heartbeat_monotonic)
+    for one of them; ``on_loss(worker_id, exc)`` is the fleet's failover
+    callback, invoked by the monitor once per newly-lost worker."""
+
+    def __init__(
+        self,
+        members: Callable[[], Sequence[int]],
+        probe_of: Callable[[int], tuple],
+        on_loss: Callable[[int, WorkerLostException], None],
+        interval: float = 0.25,
+        stall_timeout: float = 2.0,
+    ):
+        if interval <= 0:
+            raise ValueError(f"interval must be > 0, got {interval}")
+        if stall_timeout <= 0:
+            raise ValueError(
+                f"stall_timeout must be > 0, got {stall_timeout}"
+            )
+        self._members = members
+        self._probe_of = probe_of
+        self._on_loss = on_loss
+        self.interval = float(interval)
+        self.stall_timeout = float(stall_timeout)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the probe (the check_peers seam's in-process default) -----------
+
+    def _default_probe(self, timeout: float) -> List[int]:
+        """Responsive worker ids: service thread alive and heartbeat
+        fresher than ``stall_timeout``. Same contract as the multi-host
+        KV-store probe — a plain callable tests (and the chaos engine)
+        can replace."""
+        now = time.monotonic()
+        alive = []
+        for wid in self._members():
+            thread_alive, heartbeat = self._probe_of(wid)
+            if thread_alive and (now - heartbeat) <= self.stall_timeout:
+                alive.append(wid)
+        return alive
+
+    # -- one check (check_peers semantics) -------------------------------
+
+    def check_workers(
+        self,
+        timeout: Optional[float] = None,
+        on_worker_loss: str = "fail",
+        probe: Optional[Callable[[float], Sequence[int]]] = None,
+    ) -> WorkerLossReport:
+        """Verify every expected worker is responsive — the fleet twin
+        of ``check_peers``. ``"fail"`` raises typed
+        ``WorkerLostException`` naming the lost workers; ``"degrade"``
+        returns the report for the caller's failover path."""
+        if on_worker_loss not in ("fail", "degrade"):
+            raise ValueError(
+                f"on_worker_loss must be 'fail' or 'degrade', "
+                f"got {on_worker_loss!r}"
+            )
+        expected = sorted(self._members())
+        report = WorkerLossReport(n_workers=len(expected))
+        if not expected:
+            return report
+        probe = probe or self._default_probe
+        try:
+            alive, lost = probe_liveness(
+                expected,
+                timeout if timeout is not None else self.stall_timeout,
+                probe,
+            )
+        except TimeoutError as e:
+            # unattributable stall: every worker is suspect — even
+            # "degrade" cannot pick a failover target, so raise typed
+            # (the check_peers rule)
+            raise WorkerLostException(
+                f"fleet liveness probe timed out unattributably: {e}",
+                worker_ids=tuple(expected),
+            ) from e
+        report.surviving = alive
+        report.lost = lost
+        if lost and on_worker_loss == "fail":
+            raise WorkerLostException(
+                f"lost contact with fleet worker(s) {lost} "
+                f"(surviving: {alive}); their accepted requests need "
+                "failover re-dispatch",
+                worker_ids=tuple(lost),
+            )
+        return report
+
+    # -- the monitor -----------------------------------------------------
+
+    def poll(self) -> WorkerLossReport:
+        """One monitor tick: check liveness, fire ``on_loss`` for every
+        newly-lost worker (degrade mode — the fleet fails over instead
+        of aborting)."""
+        report = self.check_workers(on_worker_loss="degrade")
+        for wid in report.lost:
+            self._on_loss(
+                wid,
+                WorkerLostException(
+                    f"worker {wid} stopped heartbeating "
+                    f"(stall_timeout={self.stall_timeout:g}s)",
+                    worker_ids=(wid,),
+                ),
+            )
+        return report
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._monitor, daemon=True, name="deequ-tpu-fleet-hb"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll()
+            except WorkerLostException:
+                # unattributable probe timeout: nothing to fail over TO
+                # with confidence; keep monitoring — the next tick
+                # usually attributes (a genuinely dead fleet surfaces
+                # typed on the next submit)
+                continue
+            # deequ-lint: ignore[bare-except] -- monitor survival backstop: on_loss runs failover over tenant-influenced state (budget finalize evaluates the tenant's own checks); one bad tenant must not kill liveness detection fleet-wide — the error lands in the degradation ledger and the next tick retries
+            except Exception as e:  # noqa: BLE001
+                try:
+                    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+                    SCAN_STATS.record_degradation(
+                        "fleet_monitor_error", error=str(e),
+                        kind_of_error=type(e).__name__,
+                    )
+                except ImportError:
+                    pass
+                continue

@@ -121,6 +121,11 @@ class PlanCache:
     def __len__(self) -> int:
         return len(self._lru)
 
+    def entries(self) -> List[ServePlan]:
+        """The cached plans in LRU order (most recently used LAST) — the
+        fleet's hot-plan feed for worker-join warmup."""
+        return list(self._lru._d.values())
+
     def clear(self) -> None:
         self._lru.clear()
 

@@ -1,0 +1,120 @@
+"""Consistent-hash tenant placement — plan-cache locality that survives
+worker join/leave.
+
+The fleet's whole performance story is per-worker plan-cache locality: a
+tenant whose suite fingerprint keeps landing on the same worker reuses
+that worker's built ops, traced programs, and lint verdicts forever
+(the Flare amortization, arXiv:1703.08219 — compilation only wins when
+its cost is amortized across repeated executions). A modulo router
+would reshuffle EVERY tenant on any membership change and pay a fleet-
+wide recompilation storm at exactly the worst moment (a worker just
+died). Consistent hashing bounds the blast radius: each worker owns
+``VNODES`` pseudo-random arcs of a hash ring, a key maps to the first
+vnode clockwise, and removing a worker moves ONLY the keys that worker
+owned — every other tenant keeps its warm cache.
+
+The routing key (:func:`route_digest`) is the admission-free prefix of
+the plan fingerprint — (schema, analyzer set, row count) — hashable
+before any op build, so placement costs one SHA1 over a repr. It is
+deliberately coarser than :class:`~deequ_tpu.serve.plan_cache.PlanKey`
+(no layout signature: layouts are data-dependent and unknowable pre-
+admission); two suites that share a digest but split into distinct
+PlanKeys still both benefit — they land on one worker and each warm
+their own cache entry there.
+
+Hashes are ``hashlib`` digests, NOT Python ``hash()``: placement must be
+stable across processes and runs (PYTHONHASHSEED randomizes ``hash()``),
+or a restarted fleet would scatter every tenant's locality.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+#: ring arcs per worker — enough that 4-16 workers split keys within a
+#: few percent of even, few enough that membership ops stay trivial
+VNODES = 64
+
+
+def _hash64(text: str) -> int:
+    return int.from_bytes(
+        hashlib.sha1(text.encode()).digest()[:8], "little"
+    )
+
+
+def route_digest(data, analyzers: Sequence) -> str:
+    """The fleet routing key for one submission: a stable digest of
+    (column schema, analyzer set, row count). Analyzers contribute their
+    ``str`` form (parameters included); streaming/count-less sources
+    contribute row count 0 — they route consistently even though they
+    will serve on the serial path."""
+    try:
+        schema = tuple(sorted(
+            (name, str(data[name].dtype)) for name in data.column_names
+        ))
+    except (AttributeError, TypeError):
+        schema = ()
+    try:
+        rows = int(data.num_rows or 0)
+    except (AttributeError, TypeError):
+        rows = 0
+    payload = repr((schema, tuple(str(a) for a in analyzers), rows))
+    return hashlib.sha1(payload.encode()).hexdigest()
+
+
+class ConsistentHashRouter:
+    """The fleet's placement function (see module doc). Lock-serialized:
+    membership changes (monitor thread) race submissions (caller
+    threads)."""
+
+    def __init__(self, vnodes: int = VNODES):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.vnodes = vnodes
+        self._lock = threading.Lock()
+        self._points: List[int] = []      # sorted vnode positions
+        self._owner: dict = {}            # position -> worker id
+
+    def add_worker(self, worker_id: Any) -> None:
+        with self._lock:
+            for v in range(self.vnodes):
+                pos = _hash64(f"{worker_id}#{v}")
+                # a (vanishingly unlikely) collision keeps the earlier
+                # owner: deterministic, and the later worker still owns
+                # its other vnodes
+                if pos in self._owner:
+                    continue
+                self._owner[pos] = worker_id
+                bisect.insort(self._points, pos)
+
+    def remove_worker(self, worker_id: Any) -> None:
+        with self._lock:
+            dead = [p for p, w in self._owner.items() if w == worker_id]
+            for pos in dead:
+                del self._owner[pos]
+            if dead:
+                gone = set(dead)
+                self._points = [p for p in self._points if p not in gone]
+
+    def workers(self) -> Tuple[Any, ...]:
+        with self._lock:
+            return tuple(sorted(set(self._owner.values()), key=repr))
+
+    def place(self, digest: str) -> Optional[Any]:
+        """The worker owning ``digest``'s ring position (first vnode
+        clockwise, wrapping); None when the ring is empty."""
+        point = _hash64(digest)
+        with self._lock:
+            if not self._points:
+                return None
+            i = bisect.bisect_right(self._points, point)
+            if i == len(self._points):
+                i = 0
+            return self._owner[self._points[i]]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(set(self._owner.values()))

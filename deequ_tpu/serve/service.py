@@ -116,6 +116,14 @@ class VerificationFuture:
         self._cancelled = False
         self._started = False
         self._lock = threading.Lock()
+        #: applied resolutions (0 or 1 — chaos oracle 8's observable) and
+        #: dropped late attempts: after a fleet failover re-dispatches
+        #: this future onto a survivor, the original (stalled, presumed
+        #: dead) worker may wake and resolve it a second time — the
+        #: FIRST resolution wins, later attempts are counted here and
+        #: discarded, so an accepted future resolves exactly once
+        self.resolve_count = 0
+        self.late_resolutions = 0
         # the service's observation seam (obs/registry latency histogram
         # + optional flight-recorder submit->resolve span): called once,
         # after resolve/reject — never for a cancel (no latency to
@@ -137,7 +145,7 @@ class VerificationFuture:
             if self._started or self._done.is_set():
                 return False
             self._cancelled = True
-        self._done.set()
+            self._done.set()
         return True
 
     def result(self, timeout: Optional[float] = None):
@@ -161,26 +169,57 @@ class VerificationFuture:
     # -- service side ----------------------------------------------------
 
     def _claim(self) -> bool:
-        """Mark started; False when the consumer already cancelled."""
+        """Mark started; False when the consumer already cancelled — or
+        the future already resolved (a zombie worker re-claiming a
+        request a fleet failover completed elsewhere skips the work)."""
         with self._lock:
-            if self._cancelled:
+            if self._cancelled or self._done.is_set():
                 return False
             self._started = True
             return True
 
+    def _apply(self, result, error) -> bool:
+        """First-resolution-wins gate (see ``resolve_count``): outcome,
+        timestamp, and the done flag commit atomically under the lock,
+        so two racing resolvers can never both apply — nor can a waiter
+        wake before the outcome it will read is in place."""
+        with self._lock:
+            if self._done.is_set():
+                self.late_resolutions += 1
+                return False
+            self._result = result
+            self._error = error
+            self.resolved_at = time.monotonic()
+            self.resolve_count += 1
+            self._done.set()
+            return True
+
     def _resolve(self, result) -> None:
-        self._result = result
-        self.resolved_at = time.monotonic()
-        self._done.set()
+        if not self._apply(result, None):
+            return
         if self._on_done is not None:
             self._on_done(self, True)
 
     def _reject(self, error: BaseException) -> None:
-        self._error = error
-        self.resolved_at = time.monotonic()
-        self._done.set()
+        if not self._apply(None, error):
+            return
         if self._on_done is not None:
             self._on_done(self, False)
+
+
+class PendingWork(list):
+    """What ``stop(drain=False)`` returns: the accepted-but-unserved
+    requests PLUS the per-tenant quarantine snapshot. A plain list of
+    requests was the round-10 shape — and silently dropped the
+    quarantine ledger across kill-and-resume, so a quarantined poison
+    tenant got a fresh start after every worker recycle (the round-12
+    audit). Subclassing ``list`` keeps every existing consumer (len,
+    iteration, ``resume(pending)``) working; ``resume`` additionally
+    restores ``tenant_health`` when present."""
+
+    def __init__(self, requests=(), tenant_health: Optional[dict] = None):
+        super().__init__(requests)
+        self.tenant_health = tenant_health
 
 
 @dataclass
@@ -206,40 +245,71 @@ class ServeRequest:
 
 class _TenantHealth:
     """Consecutive-failure ledger behind tenant quarantine (half-open:
-    one serial success readmits the tenant to coalescing)."""
+    one success readmits the tenant to coalescing).
+
+    Lock-serialized because the ledger is SHAREABLE: a
+    :class:`~deequ_tpu.serve.fleet.VerificationFleet` hands ONE instance
+    to every worker service, so a poison tenant quarantined by any
+    worker is quarantined fleet-wide (and healed fleet-wide by one
+    success) — N worker threads then mutate it concurrently."""
 
     def __init__(self, threshold: int):
         self.threshold = threshold
         self.failures: Dict[Any, int] = {}
         self.quarantined: set = set()
+        self._lock = threading.Lock()
 
     def record_failure(self, tenant) -> bool:
         """Count one failure; True when this crossed the quarantine
         threshold (the caller records the degradation event)."""
         if tenant is None:
             return False
-        n = self.failures.get(tenant, 0) + 1
-        self.failures[tenant] = n
-        if n >= self.threshold and tenant not in self.quarantined:
-            self.quarantined.add(tenant)
-            return True
-        return False
+        with self._lock:
+            n = self.failures.get(tenant, 0) + 1
+            self.failures[tenant] = n
+            if n >= self.threshold and tenant not in self.quarantined:
+                self.quarantined.add(tenant)
+                return True
+            return False
 
     def record_success(self, tenant) -> None:
         if tenant is None:
             return
-        self.failures.pop(tenant, None)
-        self.quarantined.discard(tenant)
+        with self._lock:
+            self.failures.pop(tenant, None)
+            self.quarantined.discard(tenant)
 
     def is_quarantined(self, tenant) -> bool:
-        return tenant is not None and tenant in self.quarantined
+        if tenant is None:
+            return False
+        with self._lock:
+            return tenant in self.quarantined
+
+    def snapshot(self) -> dict:
+        """Kill-and-resume carrier (rides ``PendingWork``): the
+        per-tenant state a recycled worker must not forget."""
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "failures": dict(self.failures),
+                "quarantined": set(self.quarantined),
+            }
+
+    def restore(self, snap: dict) -> None:
+        """Merge a donor service's snapshot in (conservative union: a
+        tenant quarantined on either side stays quarantined; failure
+        counts keep the max)."""
+        with self._lock:
+            for tenant, n in (snap.get("failures") or {}).items():
+                self.failures[tenant] = max(self.failures.get(tenant, 0), n)
+            self.quarantined.update(snap.get("quarantined") or ())
 
 
 class VerificationService:
     """The long-lived serving entry point (see module doc)."""
 
     def __init__(self, config: Optional[ServeConfig] = None, start: bool = True,
-                 trace=None, **knobs):
+                 trace=None, device=None, tenant_health=None, **knobs):
         from deequ_tpu.obs.recorder import (
             current_recorder,
             maybe_arm_from_env,
@@ -259,7 +329,22 @@ class VerificationService:
             else current_recorder()
         )
         self.plan_cache = PlanCache(self.config.plan_cache_size)
-        self.tenant_health = _TenantHealth(self.config.quarantine_after)
+        # the quarantine ledger is injectable so a fleet can share ONE
+        # across all its workers (cross-worker quarantine); standalone
+        # services own a private one
+        self.tenant_health = (
+            tenant_health if tenant_health is not None
+            else _TenantHealth(self.config.quarantine_after)
+        )
+        #: worker placement: when set, the worker thread executes under
+        #: ``jax.default_device(device)`` — one service per chip (or
+        #: forced-host device) is the fleet's worker shape
+        self._device = device
+        #: liveness observable for fleet membership: bumped every worker
+        #: loop iteration; a worker stuck in a dispatch (or a scripted
+        #: stall) stops bumping and the heartbeat probe declares it lost
+        self.heartbeat = time.monotonic()
+        self._stall_seconds = 0.0
         # the mesh is thread-local: capture the constructing thread's
         # resolution so the worker executes under the same device view
         # (coalescing requires the single-device view; under a mesh
@@ -306,11 +391,17 @@ class VerificationService:
         )
         self._thread.start()
 
-    def stop(self, drain: bool = True) -> List[ServeRequest]:
+    def stop(self, drain: bool = True, join: bool = True) -> "PendingWork":
         """Stop the worker. ``drain=True`` serves everything already
-        queued first and returns []; ``drain=False`` stops after the
-        in-flight batch and RETURNS the still-pending requests (their
-        futures unresolved) for :meth:`resume` on another service."""
+        queued first; ``drain=False`` stops after the in-flight batch
+        and RETURNS the still-pending requests (their futures
+        unresolved) for :meth:`resume` on another service. The return
+        value is a :class:`PendingWork` — a list of the requests
+        carrying the per-tenant quarantine snapshot, so resume restores
+        WHO was quarantined, not just what was queued. ``join=False``
+        skips waiting for the worker thread (the fleet's simulated
+        process death: a stalled thread cannot be joined and its late
+        resolutions are dropped by the futures' first-wins gate)."""
         if drain:
             self.flush()
         with self._cv:
@@ -319,14 +410,20 @@ class VerificationService:
             pending = list(self._pending)
             self._pending.clear()
             self._cv.notify_all()
-        if self._thread is not None and self._thread.is_alive():
+        if join and self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=30.0)
-        return pending
+        return PendingWork(pending, tenant_health=self.tenant_health.snapshot())
 
     def resume(self, pending: Sequence[ServeRequest]) -> None:
         """Adopt another (stopped) service's pending requests: they
         re-enter this service's queue and resolve their ORIGINAL
-        futures."""
+        futures. A :class:`PendingWork` (what ``stop`` returns) also
+        restores the donor's per-tenant quarantine state — a poison
+        tenant must not get a fresh start because its worker was
+        recycled."""
+        snap = getattr(pending, "tenant_health", None)
+        if snap:
+            self.tenant_health.restore(snap)
         with self._cv:
             if self._closed:
                 raise ServiceClosedException("service is stopped")
@@ -337,6 +434,37 @@ class VerificationService:
                 req.future._on_done = self._observe_done
                 self._pending.append(req)
             self._cv.notify_all()
+
+    def inject_stall(self, seconds: float) -> None:
+        """Chaos worker seam: the worker thread sleeps ``seconds`` before
+        its next batch take — a scripted stall. The heartbeat stops
+        bumping for the duration, so fleet membership sees exactly what
+        a wedged worker looks like."""
+        with self._cv:
+            self._stall_seconds = float(seconds)
+            self._cv.notify_all()
+
+    # -- fleet warmup ----------------------------------------------------
+
+    def warm_state(self, limit: Optional[int] = None):
+        """Exportable plan-cache warm state: (hot ServePlans — most
+        recently used last, optionally the last ``limit`` —, the
+        analyzer-family admission cache). In-process transfer: plans and
+        their traced programs are host objects shared by reference."""
+        plans = self.plan_cache.entries()
+        if limit is not None:
+            plans = plans[-limit:]
+        return plans, dict(self._families._d)
+
+    def warm_from(self, plans, families) -> None:
+        """Adopt a donor's warm state (worker-join warmup: the fleet
+        calls this BEFORE admitting traffic, so a joining worker's first
+        requests hit the plan cache instead of paying trace storms)."""
+        for key, family in families.items():
+            self._families.put(key, family)
+        for plan in plans:
+            if plan.key is not None:
+                self.plan_cache.put(plan)
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Block until the queue is empty and the worker is idle."""
@@ -455,18 +583,37 @@ class VerificationService:
     def _worker(self) -> None:
         from contextlib import nullcontext
 
+        import jax
+
         from deequ_tpu.obs.recorder import recording_scope
         from deequ_tpu.parallel.mesh import use_mesh
 
         with use_mesh(self._mesh), (
+            jax.default_device(self._device)
+            if self._device is not None
+            else nullcontext()
+        ), (
             recording_scope(self._recorder)
             if self._recorder is not None
             else nullcontext()
         ):
             while True:
+                with self._cv:
+                    stall, self._stall_seconds = self._stall_seconds, 0.0
+                if stall > 0:
+                    # scripted stall (chaos worker seam): heartbeat
+                    # freezes for the duration — membership sees a
+                    # wedged worker
+                    time.sleep(stall)
+                self.heartbeat = time.monotonic()
                 batch = self._take_batch()
                 if batch is None:
                     return
+                if not batch:
+                    # empty batch = a stall became pending while idle:
+                    # loop back so the top-of-loop consumption wedges
+                    # the worker now
+                    continue
                 try:
                     self._serve_batch(batch)
                 # deequ-lint: ignore[bare-except] -- worker survival backstop: an unexpected per-batch failure rejects that batch's futures typed and the loop continues; a dead worker would strand every future forever
@@ -490,7 +637,15 @@ class VerificationService:
             while not self._pending:
                 if not self._running:
                     return None
+                if self._stall_seconds:
+                    # a stall injected while idle surfaces to the worker
+                    # loop (empty batch) so it wedges BEFORE the next
+                    # take — a scripted stall must deterministically
+                    # freeze whatever is submitted after it, not serve
+                    # one last batch first
+                    return []
                 self._idle = True
+                self.heartbeat = time.monotonic()
                 self._cv.notify_all()
                 self._cv.wait(0.1)
             self._idle = False

@@ -468,10 +468,35 @@ def test_drift_sim_is_caught_and_shrinks_to_minimal_repro():
     assert not run_schedule(shrunk).failing
 
 
+def test_generated_worker_schedules_pass_oracles_quick():
+    """The worker (fleet) seam's always-on slice: scripted worker
+    death/stall/rejoin schedules over the 4-worker fleet scenario, every
+    oracle (1/2/3/fetch/8) holding — in particular exactly-once: every
+    accepted future resolved exactly once (the larger sweep is the
+    slow-marked worker soak)."""
+    for seed in (0, 3):
+        report = run_schedule(ChaosSchedule.generate_worker(seed))
+        assert report.violations == [], (seed, report.violations)
+        assert report.fleet["accepted"] > 0
+        assert report.fleet["resolved_once"] == report.fleet["accepted"]
+        assert report.fleet["orphaned"] == 0
+        assert report.fleet["multi_resolved"] == 0
+
+
 @pytest.mark.slow
 def test_chaos_soak_200_schedules():
     """CI soak (slow tier): 200 seeded schedules, zero oracle
     violations. Runnable standalone as
     ``python -m deequ_tpu.resilience.chaos --soak``."""
     summary = soak(n=200, seed0=0, verbose=False)
+    assert summary["failures"] == []
+
+
+@pytest.mark.slow
+def test_chaos_worker_soak_50_schedules():
+    """The fleet-tier soak (slow tier): 50 seeded worker-seam schedules
+    (scripted death/stall/rejoin under load), zero oracle violations.
+    Runnable standalone as
+    ``python -m deequ_tpu.resilience.chaos --soak --worker``."""
+    summary = soak(n=50, seed0=0, verbose=False, worker=True)
     assert summary["failures"] == []
