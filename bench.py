@@ -1184,6 +1184,193 @@ def measure_fleet_failover(n_tenants: int, n_workers: int = 4):
     }
 
 
+def measure_repository_query(n_tenants: int, n_dates: int = 32):
+    """Repository-query probe (round 13, deequ_tpu/repository — ROADMAP
+    item 5's acceptance shape): an ``n_tenants x n_dates`` metric
+    history (4 Completeness series per tenant per date, dict-heavy
+    values) ingested into the columnar backend with an online
+    :class:`QualityMonitor` watching one series, then ONE cross-tenant
+    aggregate query ("completeness of column a across all tenants in
+    this window") answered two ways:
+
+    - COMPILED: ``RepositoryQuery`` lowered onto the repository's own
+      history table through the ordinary fused-scan path
+      (plan-lint ``error``, encoded int16 planes);
+    - LOADER-SIDE: the pre-columnar baseline — decode every save
+      through the loader DSL, filter by Python iteration, re-scan a
+      decoded table.
+
+    Contract asserts (the probe REFUSES to report on violation, like
+    the serving/one-fetch/config-3 asserts):
+
+    - BIT-IDENTITY: both paths produce bit-identical aggregates (same
+      engine arithmetic — the columnar path only skips the decode);
+    - ONE FETCH: the compiled query materializes exactly one
+      device->host result (the one-fetch-per-scan contract applies to
+      L9 like any scan);
+    - ENCODED STAGING: the compiled query's encoded planes stage >= 2x
+      fewer bytes than the same query forced decoded (the PR-8 gate);
+    - O(result) APPEND: bytes appended across the load grow linearly
+      (second half <= 1.05x first half), never the fs backend's
+      quadratic wall;
+    - ONLINE ALERTS: the scripted spike emits exactly one QualityAlert
+      at ingest time (no batch pull) and it reads through the
+      ``repository`` registry section.
+    """
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.anomaly.strategies import OnlineNormalStrategy
+    from deequ_tpu.metrics import DoubleMetric, Entity
+    from deequ_tpu.analyzers import Completeness
+    from deequ_tpu.analyzers.runner import AnalyzerContext
+    from deequ_tpu.repository import (
+        AnalysisResult,
+        ColumnarMetricsRepository,
+        QualityMonitor,
+        RepositoryQuery,
+        ResultKey,
+    )
+    from deequ_tpu.repository.columnar import REPO_STATS
+    from deequ_tpu.repository.monitor import MONITOR_STATS
+    from deequ_tpu.repository.query import (
+        loader_side_aggregates,
+        run_repository_query,
+    )
+    from deequ_tpu.tryresult import Success
+
+    import shutil
+    import struct
+    import tempfile
+
+    def bits(v):
+        return struct.pack("<d", float(v))
+
+    monitor = QualityMonitor()
+    monitor.watch(
+        OnlineNormalStrategy(
+            lower_deviation_factor=3.0, upper_deviation_factor=3.0
+        ),
+        metric_name="Completeness", instance="a",
+        tags={"tenant": "tenant-0"}, warmup=8, name="bench-watch",
+    )
+    # a PERSISTED repository: the O(result) append gate measures
+    # bytes_appended, which only moves on the persisted path — an
+    # in-memory repo would make that assert vacuously 0 <= 0
+    repo_dir = tempfile.mkdtemp(prefix="deequ_tpu_bench_repo_")
+    try:
+        repo = ColumnarMetricsRepository(repo_dir, monitor=monitor)
+        alerts_before = MONITOR_STATS.alerts_emitted
+
+        spike_date = n_dates - 2
+        values = [0.91, 0.93, 0.95, 0.97]
+
+        def result_for(tenant, date):
+            metric_map = {}
+            for i, col in enumerate("abcd"):
+                v = values[(date + i) % 4]
+                if col == "a" and tenant == 0 and date == spike_date:
+                    v = 0.05  # the scripted spike the monitor must catch
+                metric_map[Completeness(col)] = DoubleMetric(
+                    Entity.COLUMN, "Completeness", col, Success(v)
+                )
+            return AnalysisResult(
+                ResultKey(date, {"tenant": f"tenant-{tenant}"}),
+                AnalyzerContext(metric_map),
+            )
+
+        bytes_mark = REPO_STATS.bytes_appended
+        ingest_t0 = time.time()
+        halves = []
+        for half in range(2):
+            for date in range(half * n_dates // 2, (half + 1) * n_dates // 2):
+                for tenant in range(n_tenants):
+                    repo.save(result_for(tenant, date))
+            halves.append(REPO_STATS.bytes_appended - bytes_mark)
+            bytes_mark = REPO_STATS.bytes_appended
+        ingest_wall = time.time() - ingest_t0
+        n_saves = n_tenants * n_dates
+        assert halves[0] > 0, (
+            "repository violation: no bytes appended — the append gate "
+            "is measuring an unpersisted repository (vacuous 0 <= 0)"
+        )
+        assert halves[1] <= halves[0] * 1.05, (
+            f"repository violation: append cost grew with history "
+            f"({halves[0]}B -> {halves[1]}B across {n_saves} saves) — "
+            "the fs backend's quadratic wall is back"
+        )
+        assert MONITOR_STATS.alerts_emitted - alerts_before == 1, (
+            "repository violation: the scripted completeness spike did not "
+            "emit exactly one online QualityAlert at ingest time"
+        )
+
+        query = RepositoryQuery(
+            metric_name="Completeness", instance="a",
+            after=2, before=n_dates - 3,
+            aggregates=("count", "mean", "min", "max"),
+        )
+
+        # compiled path: warm (compile) then best-of-3, one-fetch asserted
+        run_repository_query(repo, query, plan_lint="error")
+        fused_wall = float("inf")
+        for _ in range(3):
+            SCAN_STATS.reset()
+            t0 = time.time()
+            fused = run_repository_query(repo, query, plan_lint="error")
+            fused_wall = min(fused_wall, time.time() - t0)
+        assert SCAN_STATS.device_fetches == 1, (
+            f"repository violation: the compiled query paid "
+            f"{SCAN_STATS.device_fetches} device fetches — one-fetch is the "
+            "scan contract, repository table included"
+        )
+        enc_bytes = SCAN_STATS.bytes_packed
+
+        # decoded A/B of the SAME compiled query: the PR-8 staging gate
+        SCAN_STATS.reset()
+        decoded = run_repository_query(repo, query, encoded_ingest=False)
+        dec_bytes = SCAN_STATS.bytes_packed
+        assert enc_bytes * 2 <= dec_bytes, (
+            f"repository violation: encoded query staged {enc_bytes}B vs "
+            f"{dec_bytes}B decoded — the >=2x dictionary-encoding win is gone"
+        )
+
+        # loader-side baseline: the pre-columnar answer, timed once (it is
+        # the slow path by construction) and required BIT-identical
+        t0 = time.time()
+        baseline = loader_side_aggregates(repo, query)
+        loader_wall = time.time() - t0
+        assert fused.rows == baseline.rows
+        for name, value in fused.aggregates.items():
+            assert bits(value) == bits(baseline.aggregates[name]), (
+                f"repository violation: compiled query {name}="
+                f"{value!r} != loader-side {baseline.aggregates[name]!r} — "
+                "the two paths must be BIT-identical"
+            )
+        for name, value in decoded.aggregates.items():
+            assert bits(value) == bits(fused.aggregates[name])
+
+        import deequ_tpu
+
+        section = deequ_tpu.execution_report()["repository"]
+        return {
+            "repository_query_rows": fused.rows,
+            "repository_query_wall_ms": round(fused_wall * 1000, 2),
+            "repository_loader_side_wall_ms": round(loader_wall * 1000, 2),
+            "repository_query_speedup_x": round(
+                loader_wall / max(fused_wall, 1e-9), 1
+            ),
+            "repository_ingest_saves_per_sec": round(
+                n_saves / max(ingest_wall, 1e-9), 1
+            ),
+            "repository_staged_bytes_encoded": int(enc_bytes),
+            "repository_staged_bytes_decoded": int(dec_bytes),
+            "repository_saves": section["saves"],
+            "repository_segments_written": section["segments_written"],
+            "repository_query_scan_passes": section["query_scan_passes"],
+            "repository_alerts_emitted": section["alerts_emitted"],
+        }
+    finally:
+        shutil.rmtree(repo_dir, ignore_errors=True)
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -1331,10 +1518,16 @@ def main():
     # arms itself only on >= 4-device hardware)
     fleet_probe = measure_fleet_failover(48 if smoke else 144)
     print(f"fleet probe: {fleet_probe}", file=sys.stderr)
+    # repository probe (round 13): columnar metric history, the compiled
+    # fused-scan query vs the loader-side decode A/B (bit-identity /
+    # one-fetch / >=2x encoded staging / O(result) append / online-alert
+    # gates asserted inside)
+    repo_probe = measure_repository_query(12 if smoke else 48)
+    print(f"repository probe: {repo_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
         **lint_probe, **ingest_probe, **governance_probe, **obs_probe,
-        **serving_probe, **fleet_probe,
+        **serving_probe, **fleet_probe, **repo_probe,
     }
 
     if smoke:

@@ -136,6 +136,32 @@ def config7(n_tenants: int):
     )
 
 
+def config8(n_tenants: int):
+    """REPOSITORY config (round 13, deequ_tpu/repository): an
+    ``n_tenants x 32``-date columnar metric history with the online
+    QualityMonitor watching one series, then the cross-tenant aggregate
+    query compiled onto the engine's fused-scan path vs the loader-side
+    decode baseline. ONE workload definition, shared with bench.py's
+    ``measure_repository_query`` probe, which hard-asserts — before it
+    reports anything — bit-identity between the two paths, the
+    one-fetch-per-scan contract on the compiled query, the >= 2x
+    encoded staged-byte reduction, O(result) append cost across the
+    load, and exactly one online alert for the scripted spike. The
+    emitted row carries the obs read-through of the ``repository``
+    registry section (saves, segments, query passes, alerts)."""
+    import bench
+
+    probe = bench.measure_repository_query(n_tenants)
+    return _emit(
+        config=8, metric="repository_query_speedup_x", tenants=n_tenants,
+        value=probe["repository_query_speedup_x"], unit="x vs loader-side",
+        **{
+            k: v for k, v in probe.items()
+            if k != "repository_query_speedup_x"
+        },
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -654,6 +680,10 @@ def main():
         # round-12 fleet config: the routed 4-worker load + scripted
         # worker death (failover bit-identity / exactly-once asserted)
         7: lambda: config7(args.rows or 144),
+        # round-13 repository config: columnar metric history, compiled
+        # fused-scan query vs loader-side decode (bit-identity /
+        # one-fetch / encoded-staging asserted), obs read-through
+        8: lambda: config8(args.rows or 48),
     }
     if args.all:
         for k in sorted(runners):
@@ -666,7 +696,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5,6} or --all")
+        ap.error("--config {1,2,3,4,5,6,7,8} or --all")
 
 
 if __name__ == "__main__":

@@ -512,7 +512,7 @@ def _is_newest_point_non_anomalous_assertion(
 
     def assertion(current_metric_value: float) -> bool:
         from deequ_tpu.anomaly import AnomalyDetector
-        from deequ_tpu.anomaly.history import DataPoint, extract_metric_values
+        from deequ_tpu.anomaly.history import DataPoint, history_from_loader
 
         loader = metrics_repository.load()
         if with_tag_values:
@@ -521,21 +521,14 @@ def _is_newest_point_non_anomalous_assertion(
             loader = loader.after(after_date)
         if before_date is not None:
             loader = loader.before(before_date)
-        results = loader.for_analyzers([analyzer]).get()
-
-        history = []
-        for result in results:
-            metric = result.analyzer_context.metric_map.get(analyzer)
-            value = None
-            if metric is not None and metric.value.is_success:
-                value = float(metric.value.get())
-            history.append((result.result_key.data_set_date, value))
-        history.sort(key=lambda t: t[0])
-        data_points = [DataPoint(ts, v) for ts, v in history]
+        # the ONE backend-agnostic history pull (anomaly/history.py):
+        # strictly through the loader DSL, so any MetricsRepository —
+        # in-memory, filesystem, columnar — yields the same DataPoints
+        data_points = history_from_loader(loader, analyzer)
 
         detector = AnomalyDetector(anomaly_detection_strategy)
         test_time = (
-            max((ts for ts, _ in history), default=0) + 1
+            max((p.time for p in data_points), default=0) + 1
         )
         result = detector.is_new_point_anomalous(
             data_points, DataPoint(test_time, float(current_metric_value))

@@ -247,6 +247,16 @@ FAILOVER_RETRIES = register(EnvVar(
     doc="max worker-loss re-dispatches one accepted request may ride "
         "before it rejects typed (WorkerLostException)",
 ))
+REPO_SEGMENT_ROWS = register(EnvVar(
+    "DEEQU_TPU_REPO_SEGMENT_ROWS", "int", default=4096, minimum=1,
+    doc="target scalar-metric rows per compacted columnar-repository "
+        "append segment (repository/columnar.py)",
+))
+MONITOR = register(EnvVar(
+    "DEEQU_TPU_MONITOR", "flag01", default=True,
+    doc="0 disables QualityMonitor observation process-wide (saves and "
+        "serving unaffected; alerts stop)",
+))
 TRACE = register(EnvVar(
     "DEEQU_TPU_TRACE", "flag01", default=False,
     doc="1 arms the process-global flight recorder (deequ_tpu/obs)",

@@ -90,10 +90,19 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # membership code wraps the same device-adjacent seams, so the
     # host-fetch / bare-except / typed-raise disciplines apply there
     # unchanged — a swallowed WorkerLostException would strand futures.
-    "host-fetch": ("ops/", "parallel/", "anomaly/", "serve/", "obs/"),
-    "bare-except": ("ops/", "parallel/", "resilience/", "serve/", "obs/"),
+    # repository/ joins all three in round 13: the columnar backend's
+    # query path dispatches real engine scans (host-fetch accounting
+    # applies), its segment recovery must surface CorruptStateException
+    # typed rather than swallow it, and its append/compaction code sits
+    # on the same atomic-persistence seams as resilience/.
+    "host-fetch": (
+        "ops/", "parallel/", "anomaly/", "serve/", "obs/", "repository/",
+    ),
+    "bare-except": (
+        "ops/", "parallel/", "resilience/", "serve/", "obs/", "repository/",
+    ),
     "jit-impure": ("",),
-    "typed-raise": ("ops/", "resilience/", "serve/", "obs/"),
+    "typed-raise": ("ops/", "resilience/", "serve/", "obs/", "repository/"),
     "span-in-jit": ("",),
     "suppress-reason": ("",),
 }

@@ -405,10 +405,30 @@ def _env_section() -> dict:
     }
 
 
+def _repository_section() -> dict:
+    """Read-through over the columnar metrics repository + quality
+    monitor singletons (round 13). Guarded on ``sys.modules`` rather
+    than importing: a process that never touched the repository layer
+    must not pay its import (or report phantom zeros as if it had)."""
+    import sys
+
+    out: Dict[str, Any] = {"active": False}
+    columnar = sys.modules.get("deequ_tpu.repository.columnar")
+    if columnar is not None:
+        out["active"] = True
+        out.update(columnar.REPO_STATS.snapshot())
+    monitor = sys.modules.get("deequ_tpu.repository.monitor")
+    if monitor is not None:
+        out["active"] = True
+        out.update(monitor.MONITOR_STATS.snapshot())
+    return out
+
+
 REGISTRY.register_collector("scan", _scan_section)
 REGISTRY.register_collector("retry", _retry_section)
 REGISTRY.register_collector("hbm", _hbm_section)
 REGISTRY.register_collector("env", _env_section)
+REGISTRY.register_collector("repository", _repository_section)
 
 
 # -- the serving layer's owned instruments (always-on: one histogram

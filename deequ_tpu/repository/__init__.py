@@ -14,6 +14,13 @@ from deequ_tpu.repository.base import (
 )
 from deequ_tpu.repository.memory import InMemoryMetricsRepository
 from deequ_tpu.repository.fs import FileSystemMetricsRepository
+from deequ_tpu.repository.columnar import ColumnarMetricsRepository
+from deequ_tpu.repository.monitor import QualityAlert, QualityMonitor
+from deequ_tpu.repository.query import (
+    RepositoryQuery,
+    RepositoryQueryResult,
+    run_repository_query,
+)
 
 __all__ = [
     "AnalysisResult",
@@ -22,4 +29,10 @@ __all__ = [
     "ResultKey",
     "InMemoryMetricsRepository",
     "FileSystemMetricsRepository",
+    "ColumnarMetricsRepository",
+    "QualityAlert",
+    "QualityMonitor",
+    "RepositoryQuery",
+    "RepositoryQueryResult",
+    "run_repository_query",
 ]

@@ -10,6 +10,16 @@ writes on non-atomic stores, bit rot) is detected on read and surfaced as
 a typed ``CorruptStateException`` instead of a raw ``JSONDecodeError``.
 Storage calls run under the process retry policy (transient IOErrors are
 retried with backoff). Legacy plain-JSON files keep loading.
+
+Scaling note (round-13 audit): ``save`` is read-modify-write over ONE
+JSON document of the full history — each save re-serializes every prior
+result, so N saves cost O(N²) total bytes written. That is the reference
+backend's own shape (FileSystemMetricsRepository.scala does the same)
+and is kept here for conformance; a fleet emitting hundreds of saves per
+run should use :class:`~deequ_tpu.repository.columnar.
+ColumnarMetricsRepository` instead, whose append segments make each save
+O(rows of that result) — the tier-1 ``mrepo`` regression pins ≥100
+saves/run without a quadratic wall (docs/repository.md).
 """
 
 from __future__ import annotations

@@ -95,6 +95,18 @@ class FleetConfig:
     failover_retries: Optional[int] = None
     warm_plans: int = 8
     monitor: bool = True
+    #: one :class:`~deequ_tpu.repository.monitor.QualityMonitor` shared
+    #: by EVERY worker's resolve seam (``monitor`` above is the
+    #: membership heartbeat thread — unrelated): a tenant's resolved
+    #: metrics fold into fleet-wide per-series anomaly state no matter
+    #: which worker served it. Failover re-dispatch cannot fork the
+    #: series because the observation seam hangs off the future's
+    #: first-resolution-wins gate (a late resolution from a waking
+    #: stalled worker never reaches the monitor) — NOT the monitor's
+    #: stale-point gate, which serving observations bypass by design
+    #: (they carry no dataset date, so observe_verification assigns
+    #: each point a fresh synthetic time).
+    quality_monitor: Any = None
     quarantine_after: int = 2
     run_policy: Any = None
     worker_knobs: Optional[Dict[str, Any]] = None
@@ -265,6 +277,7 @@ class VerificationFleet:
                 trace=self._trace,
                 device=self._device_for(idx),
                 tenant_health=self._tenant_health,
+                monitor=self.config.quality_monitor,
             )
 
     def _alive_ids(self) -> List[int]:

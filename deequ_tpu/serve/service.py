@@ -309,7 +309,8 @@ class VerificationService:
     """The long-lived serving entry point (see module doc)."""
 
     def __init__(self, config: Optional[ServeConfig] = None, start: bool = True,
-                 trace=None, device=None, tenant_health=None, **knobs):
+                 trace=None, device=None, tenant_health=None, monitor=None,
+                 **knobs):
         from deequ_tpu.obs.recorder import (
             current_recorder,
             maybe_arm_from_env,
@@ -340,6 +341,12 @@ class VerificationService:
         #: ``jax.default_device(device)`` — one service per chip (or
         #: forced-host device) is the fleet's worker shape
         self._device = device
+        #: online quality monitoring at the RESOLVE seam
+        #: (repository/monitor.py): every successfully resolved suite's
+        #: metrics fold into the monitor's per-series anomaly state —
+        #: serving traffic feeds the same watch rules repository saves
+        #: do. A fleet shares ONE monitor across all its workers.
+        self.monitor = monitor
         #: liveness observable for fleet membership: bumped every worker
         #: loop iteration; a worker stuck in a dispatch (or a scripted
         #: stall) stops bumping and the heartbeat probe declares it lost
@@ -563,6 +570,16 @@ class VerificationService:
         )
 
         (SERVE_RESOLVED if ok else SERVE_REJECTED).inc()
+        if ok and self.monitor is not None and future._result is not None:
+            try:
+                self.monitor.observe_verification(
+                    future.tenant, future._result
+                )
+            # deequ-lint: ignore[bare-except] -- monitoring is observation, never outcome: a watch-rule error must not reject a future that already resolved with a good result; the error is counted on MONITOR_STATS
+            except Exception:  # noqa: BLE001
+                from deequ_tpu.repository.monitor import MONITOR_STATS
+
+                MONITOR_STATS.monitor_errors += 1
         latency = future.latency_seconds
         if latency is None:
             return

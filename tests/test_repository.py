@@ -102,10 +102,18 @@ def test_full_result_serde_roundtrip(df_with_numeric_values):
         assert restored.value.is_success == metric.value.is_success
 
 
-@pytest.fixture(params=["memory", "fs"])
+@pytest.fixture(params=["memory", "fs", "columnar", "columnar_fs"])
 def repository(request, tmp_path):
     if request.param == "memory":
         return InMemoryMetricsRepository()
+    if request.param == "columnar":
+        from deequ_tpu.repository import ColumnarMetricsRepository
+
+        return ColumnarMetricsRepository()
+    if request.param == "columnar_fs":
+        from deequ_tpu.repository import ColumnarMetricsRepository
+
+        return ColumnarMetricsRepository(str(tmp_path / "segments"))
     return FileSystemMetricsRepository(str(tmp_path / "metrics.json"))
 
 
