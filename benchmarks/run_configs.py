@@ -162,6 +162,31 @@ def config8(n_tenants: int):
     )
 
 
+def config9():
+    """KERNEL-VARIANT config (round 14, ops/histogram_device.py): the
+    histogram/segment-fold kernel tier A/B — XLA scatter vs the blocked
+    one-hot matmul (vs pallas interpret for correctness) on standalone
+    bincount shapes PLUS the resident quantile scan forced through each
+    variant. ONE workload definition, shared with bench.py's
+    ``measure_kernel_ab`` probe, which hard-asserts — before it reports
+    anything — bit-exact counts vs np.bincount on every shape, plan
+    lint CLEAN in error mode per variant (the plan-hist-scatter rule at
+    zero findings), scan bit-identity + zero-sort + one-fetch under
+    each forced variant, no default-policy regression vs the scatter
+    baseline, and >=1.2x on at least one shape on this container; the
+    chip-side >=2x acceptance records live on accelerator backends and
+    banks as ``pending-parallel-hw`` on CPU-only sessions (the
+    config-3 banked-acceptance idiom)."""
+    import bench
+
+    probe = bench.measure_kernel_ab()
+    return _emit(
+        config=9, metric="kernel_ab_speedup_max",
+        value=probe["kernel_ab_speedup_max"], unit="x vs scatter",
+        **{k: v for k, v in probe.items() if k != "kernel_ab_speedup_max"},
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -684,6 +709,10 @@ def main():
         # fused-scan query vs loader-side decode (bit-identity /
         # one-fetch / encoded-staging asserted), obs read-through
         8: lambda: config8(args.rows or 48),
+        # round-14 kernel-variant config: the histogram tier A/B
+        # (scatter vs one-hot matmul vs pallas) with exactness /
+        # plan-lint / one-fetch / no-regression gates asserted inside
+        9: lambda: config9(),
     }
     if args.all:
         for k in sorted(runners):
@@ -696,7 +725,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5,6,7,8} or --all")
+        ap.error("--config {1,2,3,4,5,6,7,8,9} or --all")
 
 
 if __name__ == "__main__":

@@ -450,6 +450,10 @@ def _kll_scan_op(
         compact=_make_kll_compact(1, sketch_size),
         select_update=update_select if selectable else None,
         select_columns=(column,),
+        # the selection kernel's histogram pass widths (16-bit pass 1,
+        # then (R=k+2)x256-cell passes 2/3) — the keyspace input to the
+        # histogram kernel-variant policy (ops/device_policy.py)
+        hist_widths=(1 << 16, (sketch_size + 2) * 256 + 1),
         sorts_chunk=True,
     )
 
@@ -517,6 +521,10 @@ def _kll_multi_scan_op(columns: Tuple[str, ...], sketch_size: int) -> ScanOp:
         compact=_make_kll_compact(len(columns), sketch_size),
         select_update=update_select if selectable else None,
         select_columns=tuple(columns),
+        # per-member pass widths of the batched selection kernel (the
+        # vmap shares one traced program, so the policy input is the
+        # same single-column width set)
+        hist_widths=(1 << 16, (sketch_size + 2) * 256 + 1),
         sorts_chunk=True,
     )
 

@@ -188,6 +188,20 @@ ENCODED_INGEST = register(EnvVar(
     "DEEQU_TPU_ENCODED_INGEST", "flag01", default=True,
     doc="0 packs every column decoded (A/B hatch, PR 8)",
 ))
+HIST_VARIANT = register(EnvVar(
+    "DEEQU_TPU_HIST_VARIANT", "choice", default=None,
+    choices=("scatter", "onehot", "pallas"),
+    doc="force the histogram/segment-fold kernel variant "
+        "(ops/histogram_device.py; unset = device_policy auto — the "
+        "kernel A/B hatch, PR 14)",
+))
+HOST_GROUP_LIMIT = register(EnvVar(
+    "DEEQU_TPU_HOST_GROUP_LIMIT", "int", default=None, minimum=0,
+    doc="row count at or below which grouping bincounts/uniques run on "
+        "HOST instead of paying a device round trip (ops/segment.py "
+        "latency regime; unset = the module default 2^14; sweepable by "
+        "the kernel A/B probe, PR 14)",
+))
 DEVICE_DEADLINE = register(EnvVar(
     "DEEQU_TPU_DEVICE_DEADLINE", "float", default=None,
     zero_disables=True,

@@ -39,6 +39,17 @@ Rules (ids are stable; severities per ``findings.LintFinding``):
   (docs/numerics.md): unsorted float scatter accumulation order is
   backend-dependent. Integer scatter-adds are exempt (integer addition
   is exactly associative — the selection kernel's histogram passes).
+- ``plan-hist-scatter`` (error) — a plan declaring a matmul/pallas
+  histogram kernel variant (``ScanPlan.hist_variant`` in
+  ``("onehot", "pallas")``, ops/histogram_device.py) whose traced
+  program still contains a ``scatter-add`` primitive. The histogram
+  passes are the ONLY scatter-adds a fused scan program ever traces, so
+  any scatter-add under a non-scatter variant means the planner's
+  binding and the traced kernels drifted — the whole claimed MXU/Pallas
+  win silently reverted to the scatter lowering while the per-variant
+  dispatch census (ScanStats.hist_*_dispatches) still reports the
+  routed tier. The runtime census would only show the lie after a bench
+  run; the lint rejects the program before dispatch.
 - ``plan-encoded-decode`` (error) — an encoded-ingest plan
   (``ingest_variant="encoded"``, docs/ingest.md) whose declared encoded
   column is actually routed over a pre-decoded full-width plane
@@ -98,6 +109,17 @@ _CALLBACK_PRIMITIVES = frozenset(
 #: float-accumulating scatter primitives whose unsorted reduction order
 #: is backend-dependent (scatter-min/max and integer adds are exact)
 _ORDER_SENSITIVE_SCATTERS = frozenset(("scatter-add", "scatter-mul"))
+
+#: the scatter class the histogram kernel tier replaces: every bincount
+#: / segment-sum lowers to scatter-add, and nothing else in a fused
+#: scan program does (scatter-min/max LUT builds and the remainder
+#: compaction ``scatter`` are tiny and not histogram-shaped) — so
+#: zero scatter-adds IS the static form of "the matmul/pallas variant
+#: actually traced"
+_HIST_SCATTER_PRIMITIVES = frozenset(("scatter-add",))
+
+#: ScanPlan.hist_variant values that promise a scatter-free histogram
+_NONSCATTER_HIST_VARIANTS = frozenset(("onehot", "pallas"))
 
 #: probe values distinguishing the three elementwise monoid merges:
 #: merge(2, 3) is 5 under sum, 2 under min, 3 under max
@@ -445,6 +467,25 @@ def lint_plan(
                     "fetch happens at the drain, outside the program)",
                 )
             )
+        hist_variant = getattr(plan_ir, "hist_variant", "none")
+        if hist_variant in _NONSCATTER_HIST_VARIANTS:
+            hist_scatters = sum(
+                census.get(p, 0) for p in _HIST_SCATTER_PRIMITIVES
+            )
+            if hist_scatters:
+                findings.append(
+                    LintFinding(
+                        "plan-hist-scatter",
+                        "error",
+                        f"plan declares the {hist_variant!r} histogram "
+                        f"kernel variant but its traced program contains "
+                        f"{hist_scatters} scatter-add primitive(s): the "
+                        "bincount passes reverted to the XLA scatter "
+                        "lowering while the plan (and the per-variant "
+                        "dispatch census) claim the matmul/pallas tier — "
+                        "planner binding drift, rejected before dispatch",
+                    )
+                )
         nondet = _float_unsorted_scatters(closed.jaxpr)
         if nondet:
             findings.append(

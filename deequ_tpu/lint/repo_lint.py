@@ -95,6 +95,11 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # applies), its segment recovery must surface CorruptStateException
     # typed rather than swallow it, and its append/compaction code sits
     # on the same atomic-persistence seams as resilience/.
+    # The round-14 histogram kernel tier (ops/histogram_device.py) rides
+    # the existing ops/ prefix in every scope: its dispatcher sits
+    # directly on traced device seams, so the host-fetch / bare-except /
+    # typed-raise disciplines apply in full — a swallowed availability
+    # probe there would silently reroute every histogram to scatter.
     "host-fetch": (
         "ops/", "parallel/", "anomaly/", "serve/", "obs/", "repository/",
     ),

@@ -424,11 +424,36 @@ def _repository_section() -> dict:
     return out
 
 
+def _kernels_section() -> dict:
+    """Read-through over the histogram kernel tier (round 14,
+    ops/histogram_device.py): per-variant bincount/segment-fold dispatch
+    counts off ScanStats plus the resolved force knob — the observable
+    pair the kernel A/B probe (bench.measure_kernel_ab) reads to prove
+    the routed variant actually dispatched."""
+    from deequ_tpu.envcfg import EnvConfigError, env_value
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    try:
+        forced = env_value("DEEQU_TPU_HIST_VARIANT")
+    except EnvConfigError as e:
+        # a scrape must report the bad knob, never die on it — the same
+        # degrade-to-error-string contract _env_section keeps (the
+        # engine itself still raises typed at its own resolve)
+        forced = f"error: {e}"
+    return {
+        "hist_scatter_dispatches": SCAN_STATS.hist_scatter_dispatches,
+        "hist_onehot_dispatches": SCAN_STATS.hist_onehot_dispatches,
+        "hist_pallas_dispatches": SCAN_STATS.hist_pallas_dispatches,
+        "hist_variant_forced": forced,
+    }
+
+
 REGISTRY.register_collector("scan", _scan_section)
 REGISTRY.register_collector("retry", _retry_section)
 REGISTRY.register_collector("hbm", _hbm_section)
 REGISTRY.register_collector("env", _env_section)
 REGISTRY.register_collector("repository", _repository_section)
+REGISTRY.register_collector("kernels", _kernels_section)
 
 
 # -- the serving layer's owned instruments (always-on: one histogram

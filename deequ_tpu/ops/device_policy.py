@@ -23,7 +23,13 @@ arXiv:1703.08219). This module is the engine-side half of ours:
 - :class:`MeshHealth` is the same idea at MESH-MEMBER granularity: faults
   attributable to one chip (``DeviceException.device_ids``) cost that
   chip, not the backend — quarantined chips are excluded from future
-  meshes up front, with half-open probes readmitting them periodically.
+  meshes up front, with half-open probes readmitting them periodically;
+- :func:`resolve_hist_variant` is the histogram KERNEL-TIER policy
+  (round 14, ops/histogram_device.py): which bincount/segment-fold
+  formulation (scatter / one-hot matmul / pallas) a dispatch should
+  run, decided from keyspace width, row count, and platform — the same
+  driver the fault ladder already trusts for backend choices decides
+  kernel shape too.
 
 The degradation policies themselves (chunk bisection, degraded-mesh
 re-sharding, CPU re-jit) live in ``ops/scan_engine.py:run_scan`` — this
@@ -65,6 +71,79 @@ def current_scan_fault_hook():
     return _SCAN_FAULT_HOOK
 
 
+# -- histogram kernel-variant policy -----------------------------------------
+
+#: widest keyspace the one-hot matmul accepts on a CPU backend: the f32
+#: sgemm form wins 5-8x over XLA's CPU scatter up to here (round-14
+#: sweep, BENCHMARKS.md) and LOSES beyond — the crossover is sharp
+#: because the matmul's work is O(n * num_segments) while scatter's is
+#: O(n)
+HIST_ONEHOT_CPU_MAX_SEGMENTS = 32
+
+#: widest keyspace the one-hot matmul accepts on an accelerator: the
+#: factored (hi, lo) planes are n x (A + B) bf16 with A*B >= segments,
+#: so 2^17 keeps A, B <= 1024 — covering the selection kernel's 2^16
+#: pass-1 histogram and its default-k pass-2/3 width ((k+2)*256+1,
+#: k=256) while bounding MXU work at ~128 MACs/row/lane
+HIST_ONEHOT_MXU_MAX_SEGMENTS = 1 << 17
+
+#: below this row count the dispatch itself dominates any kernel-shape
+#: delta (the BASELINE config-1 latency regime) — the resolver keeps
+#: the scatter baseline rather than trading noise
+HIST_MIN_ROWS = 1 << 14
+
+
+def resolve_hist_variant(
+    widths,
+    rows: Optional[int] = None,
+    platform: Optional[str] = None,
+    force: Optional[str] = None,
+) -> str:
+    """Resolve the histogram kernel variant for one dispatch or plan.
+
+    ``widths`` — the histogram segment-counts the consumer will run
+    (a plan lists every pass; a host-driven kernel its one width); the
+    resolution is over the MAX, so a multi-pass program never mixes
+    variants (the plan-hist-scatter lint contract is per program).
+    ``rows`` — rows per dispatch; ``None`` means "large" (resident
+    chunks). ``force`` overrides everything (explicit argument first,
+    then the DEEQU_TPU_HIST_VARIANT env knob — the A/B hatch).
+
+    The pallas variant NEVER resolves by default: this environment's
+    tunnel compiler SIGABRTs on grid-accumulation Pallas kernels
+    (round 4, ops/hll.py), so it is force-knob-only until a chip-side
+    session proves the lowering — exactly how the chip acceptances are
+    banked as pending-parallel-hw."""
+    from deequ_tpu.envcfg import env_value
+
+    if force is None:
+        force = env_value("DEEQU_TPU_HIST_VARIANT")
+    if force is not None:
+        if force not in ("scatter", "onehot", "pallas"):
+            raise ValueError(
+                "hist variant must be one of ('scatter', 'onehot', "
+                f"'pallas'), got {force!r}"
+            )
+        return force
+    widths = tuple(int(w) for w in widths)
+    if not widths:
+        return "scatter"
+    if rows is not None and rows < HIST_MIN_ROWS:
+        return "scatter"
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    cap = (
+        HIST_ONEHOT_CPU_MAX_SEGMENTS
+        if platform == "cpu"
+        else HIST_ONEHOT_MXU_MAX_SEGMENTS
+    )
+    if max(widths) <= cap:
+        return "onehot"
+    return "scatter"
+
+
 # -- compute watchdog --------------------------------------------------------
 
 
@@ -89,6 +168,24 @@ def default_shard_deadline() -> Optional[float]:
     from deequ_tpu.envcfg import env_value
 
     return env_value("DEEQU_TPU_SHARD_DEADLINE")
+
+
+#: worker-thread-local view of the watchdog call currently executing on
+#: that thread. ScanStats' fetch accounting consults it: a late-waking
+#: ABANDONED call (its caller already raised DeviceHangException and the
+#: ladder moved on) must not bump process-global counters mid-way
+#: through a LATER run — the cross-test device_fetches race the tier-1
+#: oom_mid_fold deflake closes (round 14).
+_WATCHDOG_TLS = threading.local()
+
+
+def current_watchdog_call_abandoned() -> bool:
+    """True iff the CALLING thread is a watchdog worker whose in-flight
+    call timed out and was abandoned — its side effects on shared
+    telemetry must be dropped, not recorded against whatever run is
+    active by the time the hung call finally wakes."""
+    state = getattr(_WATCHDOG_TLS, "state", None)
+    return bool(state is not None and state.get("abandoned"))
 
 
 class _WatchdogPool:
@@ -116,12 +213,18 @@ class _WatchdogPool:
         def loop():
             while True:
                 fn, box, done, state = inbox.get()
+                # publish the call state to this thread before running:
+                # telemetry written from INSIDE the call (record_fetch)
+                # can then check whether the call was abandoned mid-way
+                _WATCHDOG_TLS.state = state
                 try:
                     box["value"] = fn()
                 # deequ-lint: ignore[bare-except] -- watchdog worker forwards the exception to the caller thread via box['error'], re-raised there
                 except BaseException as e:  # noqa: BLE001 — re-raised on
                     # the caller thread
                     box["error"] = e
+                finally:
+                    _WATCHDOG_TLS.state = None
                 done.set()
                 # drop the job references BEFORE parking: an idle worker
                 # must not pin the last call's closure (which can hold a

@@ -1,0 +1,346 @@
+"""Histogram / segment-fold kernel tier: scatter, one-hot-MXU, Pallas.
+
+Every remaining compute risk in the engine has the same shape — XLA's
+TPU ``scatter`` lowering: the selection kernel's three bincount passes
+(``ops/select_device.py``), the grouping path's scatter-add bincounts
+and segment reductions (``ops/segment.py``), and the HLL register fold
+before round 5 fixed it. The fix-idiom is already proven in this repo:
+``ops/hll.py`` replaced a scatter-max register fold (~20 ns/row on the
+bench chip) with a blocked one-hot bf16 MXU matmul for ~10x. This
+module generalizes that idiom into a routed KERNEL TIER every
+histogram-shaped reduction shares:
+
+- ``"scatter"`` — the XLA lowering the engine has always run
+  (``zeros.at[seg].add(w)``): the baseline every other variant is
+  hard-asserted bit-exact against;
+- ``"onehot"`` — the factored blocked one-hot matmul: a segment id
+  splits into (hi, lo) digits of a B-wide radix, and the counts matrix
+  is ``one_hot(hi)^T @ one_hot(lo)`` accumulated over row blocks. On
+  the MXU the planes ride bf16 (products are exactly 0/1); on CPU they
+  ride f32 (bf16 is software-emulated there — measured 8x SLOWER than
+  scatter, while the f32 sgemm form wins 5-8x on narrow keyspaces).
+  Per-block accumulation is f32 (block <= 2^18 rows, so every count
+  fits f32's 2^24 integer range exactly) folded into an integer
+  accumulator per block — counts are EXACT at any total row count;
+- ``"pallas"`` — a Mosaic kernel for keyspaces too wide for the
+  one-hot planes to fit: grid over (segment blocks x row blocks), each
+  step reduces a compare-against-iota tile into its output block (a
+  VPU formulation — no scatter, no sorted structure). GUARDED: round 4
+  measured this environment's tunnel compiler SIGABRTing on
+  grid-accumulation Pallas kernels (see ops/hll.py), so the variant
+  never resolves by default — it is reachable only through the
+  DEEQU_TPU_HIST_VARIANT force knob and runs interpret-mode on CPU
+  backends (the correctness harness tier-1 exercises).
+
+Routing is a PLAN decision, not a call-site decision: the planner
+(``ops/scan_plan.py``) resolves a ``hist_variant`` per scan attempt via
+``ops/device_policy.resolve_hist_variant`` (keyspace width / row count
+/ platform / force knob) and binds it around the traced update via
+:func:`active_hist_variant`; host-driven kernels (``ops/segment.py``)
+resolve per dispatch through the same policy fn. ``bincount`` reads the
+ambient variant so traced code never threads variant arguments — the
+traced-program caches stay correct because every consumer keys its
+program on the resolved variant. The static twin of the routing is the
+``plan-hist-scatter`` lint rule (deequ_tpu/lint/plan_lint.py): a plan
+claiming a matmul/pallas hist variant must trace to a jaxpr with ZERO
+``scatter-add`` primitives.
+
+Exactness contract (docs/kernels.md): all three variants produce
+IDENTICAL integer histograms — one-hot products are 0/1 in either
+plane dtype, per-block f32 accumulation is exact below 2^24, and the
+cross-block fold is integer addition. The ``kernelv`` tier-1 suite
+pins parity against ``np.bincount`` across dtypes, widths, block
+boundaries, and null slots.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+from typing import Optional
+
+import numpy as np
+
+#: the variants a histogram dispatch can resolve to (order = preference
+#: order for documentation; resolution lives in device_policy)
+HIST_VARIANTS = ("scatter", "onehot", "pallas")
+
+#: one-hot radix width: 128 matches both the MXU/VPU lane count and the
+#: CPU sgemm sweet spot measured in round 14 (B > 128 only widens the
+#: matmul without narrowing the hi plane)
+_ONEHOT_LANES = 128
+
+#: row-block sizing: planes are (block, A) + (block, B) elements; the
+#: budget caps their footprint (~128MB f32 at 2^25 elements) so a
+#: vmapped consumer (the batched selection kernel) stays inside HBM,
+#: while the floor keeps each matmul big enough to amortize dispatch
+_ONEHOT_PLANE_BUDGET = 1 << 25
+_ONEHOT_MAX_BLOCK = 1 << 18
+_ONEHOT_MIN_BLOCK = 1 << 12
+
+# -- active-variant seam ------------------------------------------------------
+
+#: ambient variant for traced histogram calls. A ContextVar (not a bare
+#: module global): serve workers trace programs from their own threads,
+#: and a variant bound for one attempt must never leak into another
+#: thread's trace.
+_ACTIVE_VARIANT: contextvars.ContextVar = contextvars.ContextVar(
+    "deequ_tpu_hist_variant", default="scatter"
+)
+
+
+def current_hist_variant() -> str:
+    """The variant ambient histogram calls resolve to ("scatter" unless
+    a planner bound one — see :func:`active_hist_variant`)."""
+    return _ACTIVE_VARIANT.get()
+
+
+@contextmanager
+def active_hist_variant(variant: str):
+    """Bind the ambient histogram variant for the duration of a traced
+    update call (the planner wraps resolved select updates with this, so
+    the binding is live exactly while THAT op's portion of the program
+    traces — never at dispatch time, where it would be dead weight)."""
+    if variant not in HIST_VARIANTS:
+        raise ValueError(
+            f"hist variant must be one of {HIST_VARIANTS}, got {variant!r}"
+        )
+    token = _ACTIVE_VARIANT.set(variant)
+    try:
+        yield
+    finally:
+        _ACTIVE_VARIANT.reset(token)
+
+
+def pallas_available() -> bool:
+    """True when jax ships the Pallas frontend this process can trace
+    (CPU backends run it interpret-mode). Deliberately NOT a statement
+    about the tunnel compiler accepting the lowered kernel — that is
+    exactly the round-4 SIGABRT risk the policy never auto-routes into."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+    # deequ-lint: ignore[bare-except] -- availability probe: absence of the pallas frontend IS the answer
+    except Exception:  # noqa: BLE001 — jax built without pallas
+        return False
+    return True
+
+
+# -- kernels ------------------------------------------------------------------
+
+
+def _onehot_geometry(num_segments: int):
+    """(A, B, block): hi/lo radix split + row block for one keyspace."""
+    B = min(_ONEHOT_LANES, max(8, int(num_segments)))
+    A = (int(num_segments) + B - 1) // B
+    block = max(
+        _ONEHOT_MIN_BLOCK,
+        min(_ONEHOT_MAX_BLOCK, _ONEHOT_PLANE_BUDGET // (A + B)),
+    )
+    return A, B, block
+
+
+def _plane_dtype(xp):
+    """One-hot plane dtype: bf16 rides the MXU on accelerators; CPU
+    backends keep f32 (bf16 is software-emulated there — measured ~8x
+    slower than the f32 sgemm it replaces). Products are exactly 0/1
+    either way, so the choice is pure speed, never accuracy."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return xp.float32
+    return xp.bfloat16
+
+
+def bincount_onehot(seg, num_segments: int, xp, weights=None, dtype=None):
+    """Bincount (or integer-weighted segment sum) as a blocked factored
+    one-hot matmul — the ops/hll.py MXU idiom generalized.
+
+    ``seg`` is an (n,) integer array; counts cover ``[0, num_segments)``
+    with out-of-range ids (negative sentinels, the trailing invalid
+    slot a caller did not allocate) DROPPED — exactly the scatter
+    path's semantics. Exactness: per-block f32 accumulation never
+    exceeds the block row count (< 2^24), and blocks fold in integer
+    arithmetic; with ``weights`` the caller must keep per-segment
+    per-block totals below 2^24 (the engine only ever folds ones)."""
+    dtype = dtype or xp.int32
+    A, B, block = _onehot_geometry(num_segments)
+    n = seg.shape[0]
+    plane = _plane_dtype(xp)
+    import jax
+
+    seg = seg.astype(xp.int32)
+    counts = xp.zeros((A, B), dtype=dtype)
+    for s in range(0, n, block):
+        sb = seg[s:s + block]
+        hi = sb // B  # floor division: negatives land < 0 -> zero row
+        lo = sb - hi * B
+        oh = jax.nn.one_hot(hi, A, dtype=plane)
+        ol = jax.nn.one_hot(lo, B, dtype=plane)
+        if weights is not None:
+            # the weighted lo plane rides f32 regardless of backend: a
+            # bf16 plane would round integer weights above 256 and break
+            # the exact-counts contract (the hi plane stays 0/1, so only
+            # this operand widens; the matmul promotes to f32)
+            ol = ol.astype(xp.float32) * weights[
+                s:s + block
+            ].astype(xp.float32)[:, None]
+        counts = counts + xp.matmul(
+            oh.T, ol, preferred_element_type=xp.float32
+        ).astype(dtype)
+    return counts.reshape(-1)[:num_segments]
+
+
+# pallas tile geometry: multiples of the (8, 128) f32 TPU tile so the
+# same kernel shape lowers on Mosaic when the force knob ever runs it
+# chip-side; interpret mode (CPU) accepts them regardless
+_PALLAS_SEG_BLOCK = 512
+_PALLAS_ROW_BLOCK = 1024
+
+
+def bincount_pallas(
+    seg,
+    num_segments: int,
+    xp,
+    weights=None,
+    dtype=None,
+    interpret: Optional[bool] = None,
+):
+    """Bincount as a Pallas grid kernel: grid (segment blocks, row
+    blocks), each step reducing a compare-against-iota tile into its
+    output block — O(n * num_segments) VPU compares with NO scatter and
+    no sorted structure, the formulation for keyspaces too wide for the
+    one-hot planes. ``interpret`` defaults to True off-TPU (the tier-1
+    correctness harness); chip-side lowering stays behind the force
+    knob (round-4 tunnel-compiler SIGABRT risk, module doc)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    dtype = dtype or xp.int32
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = seg.shape[0]
+    seg = seg.astype(xp.int32)
+    w = None if weights is None else weights.astype(xp.int32)
+    nrb = max(1, (n + _PALLAS_ROW_BLOCK - 1) // _PALLAS_ROW_BLOCK)
+    pad = nrb * _PALLAS_ROW_BLOCK - n
+    if pad:
+        # -1 matches no segment id: padding rows are dropped like any
+        # other out-of-range sentinel
+        seg = xp.concatenate([seg, xp.full((pad,), -1, xp.int32)])
+        if w is not None:
+            w = xp.concatenate([w, xp.zeros((pad,), xp.int32)])
+    nsb = (num_segments + _PALLAS_SEG_BLOCK - 1) // _PALLAS_SEG_BLOCK
+    seg2 = seg.reshape(nrb, _PALLAS_ROW_BLOCK)
+    args = [seg2]
+    in_specs = [
+        pl.BlockSpec((1, _PALLAS_ROW_BLOCK), lambda j, k: (k, 0)),
+    ]
+    if w is not None:
+        args.append(w.reshape(nrb, _PALLAS_ROW_BLOCK))
+        in_specs.append(
+            pl.BlockSpec((1, _PALLAS_ROW_BLOCK), lambda j, k: (k, 0))
+        )
+
+    def kernel(seg_ref, *rest):
+        w_ref, out_ref = (
+            (rest[0], rest[1]) if len(rest) == 2 else (None, rest[0])
+        )
+        k = pl.program_id(1)
+
+        @pl.when(k == 0)
+        def _():
+            out_ref[...] = xp.zeros_like(out_ref)
+
+        s = seg_ref[...]  # (1, row_block)
+        base = pl.program_id(0) * _PALLAS_SEG_BLOCK
+        # TPU iota must be >= 2D (pallas guide); (seg_block, 1) then
+        # broadcast against the (1, row_block) ids
+        ids = base + jax.lax.broadcasted_iota(
+            xp.int32, (_PALLAS_SEG_BLOCK, 1), 0
+        )
+        match = (s == ids).astype(xp.int32)  # (seg_block, row_block)
+        if w_ref is not None:
+            match = match * w_ref[...]
+        # pin the accumulator dtype: jnp.sum promotes i32 to the default
+        # int (i64 under x64), which the i32 out ref would reject
+        out_ref[...] += xp.sum(
+            match, axis=1, keepdims=True, dtype=xp.int32
+        ).T
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nsb, nrb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, _PALLAS_SEG_BLOCK), lambda j, k: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((nsb, _PALLAS_SEG_BLOCK), xp.int32),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(-1)[:num_segments].astype(dtype)
+
+
+def bincount_scatter(seg, num_segments: int, xp, weights=None, dtype=None):
+    """The XLA scatter-add lowering (baseline variant). The tier
+    contract is explicit: ids outside [0, num_segments) are DROPPED,
+    never wrapped — jax normalizes negative ``.at`` indices numpy-style
+    before any out-of-bounds mode applies, so negatives are pre-mapped
+    to an out-of-range sentinel that ``mode="drop"`` then discards
+    (engine callers all pre-map invalid rows to an allocated trailing
+    slot anyway; the sentinel only defends the contract). The
+    unweighted form adds a scalar 1 rather than an all-ones operand
+    (measured ~2x faster on CPU — the historical select-kernel
+    formulation)."""
+    dtype = dtype or xp.int32
+    zeros = xp.zeros((num_segments,), dtype=dtype)
+    safe = xp.where(seg < 0, num_segments, seg)
+    if weights is None:
+        return zeros.at[safe].add(1, mode="drop")
+    return zeros.at[safe].add(weights.astype(dtype), mode="drop")
+
+
+_KERNELS = {
+    "scatter": bincount_scatter,
+    "onehot": bincount_onehot,
+    "pallas": bincount_pallas,
+}
+
+
+def bincount_variant(
+    variant: str, seg, num_segments: int, xp, weights=None, dtype=None
+):
+    """Histogram under an EXPLICIT variant — the host-driven kernels
+    (ops/segment.py) resolve per dispatch via device_policy and key
+    their jit caches on the resolved variant, so the ambient-binding
+    seam (which exists for PLAN-routed traced code) would be dead
+    weight there."""
+    if variant not in HIST_VARIANTS:
+        raise ValueError(
+            f"hist variant must be one of {HIST_VARIANTS}, got {variant!r}"
+        )
+    return _KERNELS[variant](
+        seg, num_segments, xp, weights=weights, dtype=dtype
+    )
+
+
+def bincount(seg, num_segments: int, xp, weights=None, dtype=None):
+    """Histogram of integer segment ids under the AMBIENT variant
+    (:func:`current_hist_variant`; "scatter" unless a planner bound one).
+    All variants share one contract: counts over ``[0, num_segments)``,
+    out-of-range ids dropped, exact integer results. Host numpy callers
+    always take ``np.bincount`` — the variants are device formulations
+    and the host path is already the latency-regime answer."""
+    if xp is np:
+        slots = np.where(
+            (seg >= 0) & (seg < num_segments), seg, num_segments
+        )
+        if weights is None:
+            counts = np.bincount(slots, minlength=num_segments + 1)
+        else:
+            # np.bincount's weighted form accumulates float64 — exact
+            # for the small integer weights this tier admits; cast back
+            counts = np.bincount(
+                slots, weights=weights, minlength=num_segments + 1
+            )
+        return counts[:num_segments].astype(dtype or np.int64)
+    return _KERNELS[current_hist_variant()](
+        seg, num_segments, xp, weights=weights, dtype=dtype
+    )

@@ -30,6 +30,7 @@ from __future__ import annotations
 import itertools
 import math
 import os
+import threading
 import weakref
 from contextlib import nullcontext
 from dataclasses import dataclass, field
@@ -58,6 +59,7 @@ from deequ_tpu.obs.recorder import (
 from deequ_tpu.ops.device_policy import (
     DEVICE_HEALTH,
     MESH_HEALTH,
+    current_watchdog_call_abandoned,
     default_device_deadline,
     _call_with_deadline,
     default_shard_deadline,
@@ -228,6 +230,11 @@ class ScanOp:
     # fault ladder never sees the substitution.
     select_update: Optional[Callable[[Dict[str, Val], Any, Any, int], Any]] = None
     select_columns: Tuple[str, ...] = ()
+    # histogram segment-counts the select path's bincount passes run
+    # (ops/select_device.py: 2^16 + (k+2)*256+1) — the keyspace-width
+    # input to the histogram kernel-variant policy
+    # (ops/device_policy.resolve_hist_variant); () = no histogram passes
+    hist_widths: Tuple[int, ...] = ()
     # True when `update` runs a full device sort per chunk (the KLL
     # summary kernels) — the census behind ScanStats.device_sort_passes
     sorts_chunk: bool = False
@@ -241,6 +248,11 @@ class ScanStats:
     counting device passes; users read it via deequ_tpu.execution_report()."""
 
     def __init__(self):
+        # fetch accounting is written from caller threads AND watchdog
+        # workers; the lock makes record_fetch's read-modify-write (and
+        # snapshot()'s view of the pair) atomic — a lost update would
+        # silently falsify the one-fetch contract asserts
+        self._fetch_lock = threading.Lock()
         self.reset()
 
     def reset(self) -> None:
@@ -261,6 +273,17 @@ class ScanStats:
         # the resident selection path device_sort_passes stays 0 and
         # this counts what replaced it — the config-3 contract pair
         self.device_select_passes = 0
+        # histogram kernel-tier census (ops/histogram_device.py, round
+        # 14): bincount/segment-fold dispatches per variant — the
+        # selection kernel's three passes count under the plan's
+        # resolved hist_variant, the grouping kernels
+        # (ops/segment.py) under their per-dispatch resolution. The
+        # obs registry's "kernels" section reads these through; the
+        # kernel A/B probe (bench.measure_kernel_ab) asserts the
+        # routed variant actually dispatched
+        self.hist_scatter_dispatches = 0
+        self.hist_onehot_dispatches = 0
+        self.hist_pallas_dispatches = 0
         # device->host result bytes (grouping paths): the sparse group-by
         # contract is fetched bytes ~ O(k*G), never O(k*n)
         self.bytes_fetched = 0
@@ -365,7 +388,14 @@ class ScanStats:
         return self.chunks_staged_overlapped / self.chunks_staged
 
     def snapshot(self) -> dict:
-        snap = dict(self.__dict__)
+        # the synchronized read of the fetch ledger (tests assert the
+        # one-fetch contract through here); private fields (the lock)
+        # never enter reports
+        with self._fetch_lock:
+            snap = {
+                k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")
+            }
         # events are mutable rows — hand out a copy so a caller's report
         # is a point-in-time record, not a live view
         snap["degradation_events"] = [dict(e) for e in self.degradation_events]
@@ -392,9 +422,28 @@ class ScanStats:
 
     def record_fetch(self, nbytes: int) -> None:
         """Account one device->host materialization (the unit the
-        one-fetch-per-scan contract counts) and its result bytes."""
-        self.device_fetches += 1
-        self.bytes_fetched += int(nbytes)
+        one-fetch-per-scan contract counts) and its result bytes.
+
+        Fetches performed by an ABANDONED watchdog call are dropped: the
+        call's scan already failed typed (DeviceHangException) and the
+        ladder moved on — when the hung device call finally wakes,
+        possibly a whole test later, its counter bump would land on
+        whatever run is active then (the cross-test device_fetches race
+        behind the historical oom_mid_fold tier-1 flake)."""
+        if current_watchdog_call_abandoned():
+            return
+        with self._fetch_lock:
+            self.device_fetches += 1
+            self.bytes_fetched += int(nbytes)
+
+    def record_hist_dispatch(self, variant: str, n: int = 1) -> None:
+        """Account ``n`` histogram/segment-fold kernel dispatches under
+        their resolved variant (ops/histogram_device.py tier). Written
+        from serve/fleet worker threads like the fetch ledger, so the
+        read-modify-write shares its lock."""
+        field_name = f"hist_{variant}_dispatches"
+        with self._fetch_lock:
+            setattr(self, field_name, getattr(self, field_name) + int(n))
 
     def record_staged(self, nbytes: int, overlapped: bool) -> None:
         """Account one HOST->DEVICE chunk staging (the double-buffered
@@ -1655,10 +1704,11 @@ def fetch_deferred(scans: Sequence["DeferredScan"]) -> None:
     # (the per-scan folder.drain calls below see numpy slices and count
     # nothing)
     SCAN_STATS.drain_wait_seconds += _time.time() - t0
-    SCAN_STATS.device_fetches += (
-        len(arrays) if (len(arrays) > 1 and not same_device) else 1
-    )
-    SCAN_STATS.bytes_fetched += sum(p.nbytes for p in parts)
+    with SCAN_STATS._fetch_lock:
+        SCAN_STATS.device_fetches += (
+            len(arrays) if (len(arrays) > 1 and not same_device) else 1
+        )
+        SCAN_STATS.bytes_fetched += sum(p.nbytes for p in parts)
     i = 0
     for s in pending:
         n_parts = len(s._in_flight)
@@ -1690,14 +1740,26 @@ MIN_BISECT_CHUNK_ROWS = 64
 _SCAN_IDS = itertools.count()
 
 
+#: histogram passes one selection-kernel summary dispatch runs (the
+#: 16+8+8-bit radix plan of ops/select_device._select_u32_multirank)
+_HIST_PASSES_PER_SELECT = 3
+
+
 def _record_kernel_passes(plan_ir, chunks: int) -> None:
     """Account the per-chunk KLL/quantile kernel census of one or more
     chunk dispatches (ops/scan_plan.py): how many ran a device sort vs
     the histogram selection kernel — the observable behind the config-3
-    zero-sort contract."""
+    zero-sort contract — and, for selection dispatches, the histogram
+    kernel-variant census (each selection summary runs three bincount
+    passes under the plan's resolved hist_variant)."""
     if chunks:
         SCAN_STATS.device_sort_passes += plan_ir.sort_ops * chunks
         SCAN_STATS.device_select_passes += plan_ir.select_ops * chunks
+        if plan_ir.select_ops and plan_ir.hist_variant != "none":
+            SCAN_STATS.record_hist_dispatch(
+                plan_ir.hist_variant,
+                _HIST_PASSES_PER_SELECT * plan_ir.select_ops * chunks,
+            )
 
 
 def _maybe_plan_lint(
@@ -1749,6 +1811,7 @@ def _maybe_plan_lint(
                 memo_key = (
                     global_key,
                     plan_ir.variant,
+                    plan_ir.hist_variant,
                     plan_ir.ingest_variant,
                     plan_ir.encoded_columns,
                     plan_ir.fold_tags,
@@ -2439,7 +2502,8 @@ def _run_scan_once(
     # selection kernel; re-planned per attempt, so an OOM retry that
     # evicted residency falls back to the sort path by construction
     plan_ir = plan_scan_ops(
-        ops, packer, resident=cache is not None, select_kernel=select_kernel
+        ops, packer, resident=cache is not None,
+        select_kernel=select_kernel, rows=chunk,
     )
     ops = plan_ir.ops
     report["encoded"] = plan_ir.ingest_variant == "encoded"

@@ -115,6 +115,20 @@ class ScanPlan:
       device-foldable (the whole scan pays one device->host fetch) else
       ``"per-chunk"``; traced programs must contain no host callbacks
       either way;
+    - ``hist_variant`` — the histogram/segment-fold kernel tier the
+      plan's bincount passes ride (ops/histogram_device.py, round 14):
+      ``"scatter"`` (the XLA lowering), ``"onehot"`` (blocked one-hot
+      matmul — MXU on chip, sgemm on CPU), ``"pallas"`` (force-knob
+      only), or ``"none"`` when the plan runs no histogram passes. A
+      matmul/pallas-variant plan must trace to a jaxpr with ZERO
+      ``scatter-add`` primitives — the ``plan-hist-scatter`` lint rule,
+      the static twin of the per-variant dispatch counters on
+      ScanStats. Resolved per attempt by
+      ``device_policy.resolve_hist_variant`` from the select ops'
+      declared histogram widths (``ScanOp.hist_widths``), the chunk row
+      count, and the platform, and BOUND around the resolved update at
+      trace time (``histogram_device.active_hist_variant``) so the
+      traced program and the declaration can never drift;
     - ``ingest_variant`` — ``"encoded"`` when at least one column rides
       the packer's int16 ``enc`` plane (dictionary codes on device,
       decode gathered inside the fused program), else ``"decoded"``.
@@ -129,6 +143,9 @@ class ScanPlan:
     select_ops: int = 0
     sort_ops: int = 0
     variant: str = "none"
+    #: histogram kernel tier of the plan's bincount passes ("none" when
+    #: the plan runs no histogram passes at all) — see class doc
+    hist_variant: str = "none"
     fold_tags: Tuple[Tuple[str, ...], ...] = ()
     fetch_contract: str = "per-chunk"
     ingest_variant: str = "decoded"
@@ -217,28 +234,79 @@ def _selectable(op, packer) -> bool:
     return all(c in keyed for c in op.select_columns)
 
 
+def _bind_hist_variant(update, variant: str):
+    """Wrap a resolved update so the ambient histogram variant is bound
+    exactly while THIS op's portion of the program traces — the traced
+    bincount passes (select_device._segment_count ->
+    histogram_device.bincount) read it there, and nowhere else. Binding
+    at plan time (not executor time) means plan lint's own trace of the
+    program sees the identical kernels the executor will jit."""
+    from deequ_tpu.ops.histogram_device import active_hist_variant
+
+    def bound_update(vals, row_valid, xp, n):
+        with active_hist_variant(variant):
+            return update(vals, row_valid, xp, n)
+
+    return bound_update
+
+
 def plan_scan_ops(
     ops: Sequence,
     packer=None,
     resident: bool = False,
     select_kernel: Optional[bool] = None,
+    rows: Optional[int] = None,
 ) -> ScanPlan:
-    """Resolve kernel variants for one scan attempt (see module doc)."""
+    """Resolve kernel variants for one scan attempt (see module doc).
+    ``rows`` is the attempt's chunk row count when the caller knows it
+    (the resident path does) — one input to the histogram-variant
+    policy; ``None`` means "large"."""
+    from deequ_tpu.ops.device_policy import resolve_hist_variant
+
     use_select = resident and select_kernel_enabled(select_kernel)
+    # ONE routing predicate, evaluated once per op: the flags below
+    # drive BOTH the histogram-variant decision and the routing loop,
+    # so the declared variant can never drift from the ops that
+    # actually trace it
+    routed = [
+        op.select_update is not None and use_select and _selectable(
+            op, packer
+        )
+        for op in ops
+    ]
+    # the histogram-variant decision is PER PLAN, over the widest
+    # histogram any select-routed op will run: a multi-pass program must
+    # never mix variants or the plan-hist-scatter lint contract (and the
+    # per-variant dispatch census) would be unstatable
+    hist_variant = "none"
+    if any(routed):
+        hist_variant = resolve_hist_variant(
+            tuple(
+                w
+                for op, sel in zip(ops, routed)
+                if sel
+                for w in (op.hist_widths or ())
+            ),
+            rows=rows,
+        )
     resolved = []
     n_select = 0
     n_sort = 0
-    for op in ops:
-        if op.select_update is not None and use_select and _selectable(
-            op, packer
-        ):
+    for op, sel in zip(ops, routed):
+        if sel:
             key = (
-                ("select", op.cache_key)
+                ("select", hist_variant, op.cache_key)
                 if op.cache_key is not None
                 else None
             )
             resolved.append(
-                replace(op, update=op.select_update, cache_key=key)
+                replace(
+                    op,
+                    update=_bind_hist_variant(
+                        op.select_update, hist_variant
+                    ),
+                    cache_key=key,
+                )
             )
             n_select += 1
         else:
@@ -269,6 +337,7 @@ def plan_scan_ops(
         select_ops=n_select,
         sort_ops=n_sort,
         variant=variant,
+        hist_variant=hist_variant,
         fold_tags=tuple(
             tuple(str(t) for t in jax.tree.leaves(op.tags))
             for op in resolved

@@ -37,8 +37,23 @@ SMALL_N_FETCH_LIMIT = 1 << 16
 # below this row count grouping work runs entirely on HOST: a tiny input's
 # device pass costs a dispatch+fetch round trip (~0.1s on the tunnel, and
 # still dominated by launch latency on a local chip) for microseconds of
-# host work — the latency-dominated regime of BASELINE config 1
+# host work — the latency-dominated regime of BASELINE config 1.
+# Promoted to a sweepable knob in round 14: the kernel A/B probe sweeps
+# DEEQU_TPU_HOST_GROUP_LIMIT to measure the crossover on its own
+# hardware; this constant is the unset-knob default (tests monkeypatch
+# it directly, which the helper below honors)
 HOST_GROUP_LIMIT = 1 << 14
+
+
+def host_group_limit() -> int:
+    """The effective host-fallback row threshold: the registered
+    DEEQU_TPU_HOST_GROUP_LIMIT knob when set, else the module default
+    (``HOST_GROUP_LIMIT`` — still a plain module attribute so existing
+    monkeypatch-based tests keep steering the un-swept default)."""
+    from deequ_tpu.envcfg import env_value
+
+    value = env_value("DEEQU_TPU_HOST_GROUP_LIMIT")
+    return HOST_GROUP_LIMIT if value is None else value
 
 
 def _pad_group_count(g: int) -> int:
@@ -101,7 +116,7 @@ def _device_unique_inverse(
     n = len(values)
     if n == 0:
         return np.empty(0, dtype=values.dtype), np.zeros(0, dtype=np.int64)
-    if n <= HOST_GROUP_LIMIT and values.dtype != np.float64:
+    if n <= host_group_limit() and values.dtype != np.float64:
         # latency-dominated regime: a tiny input's device sort costs one
         # dispatch+fetch round trip (~0.1s on the tunnel) for microseconds
         # of work — run the identical unique/inverse on host. FRACTIONAL
@@ -208,7 +223,7 @@ def _device_matrix_rle(
     k, n = code_matrix.shape
     if n == 0:
         return code_matrix[:, :0], np.zeros(0, dtype=np.int64)
-    if n <= HOST_GROUP_LIMIT:
+    if n <= host_group_limit():
         # latency-dominated regime (see _device_unique_inverse): the same
         # lexsort + adjacent-compare on host, identical results, zero
         # device round trips
@@ -289,39 +304,70 @@ def column_key_codes(col: Column) -> Tuple[np.ndarray, List]:
 from functools import lru_cache
 
 
+def _count_slots(slot, num_segments: int, variant: str):
+    """Traced: counts over ``num_segments + 1`` slots under the routed
+    kernel tier (ops/histogram_device.py). The scatter variant keeps the
+    historical ``segment_sum``-of-ones formulation bit-for-bit; the
+    one-hot/pallas variants replace the scatter-add with the blocked
+    matmul / Mosaic grid kernel, exact by the tier's integer-count
+    contract."""
+    if variant == "scatter":
+        return jax.ops.segment_sum(
+            jnp.ones_like(slot, dtype=jnp.int64), slot,
+            num_segments=num_segments + 1,
+        )
+    from deequ_tpu.ops.histogram_device import bincount_variant
+
+    return bincount_variant(
+        variant, slot, num_segments + 1, jnp, dtype=jnp.int64
+    )
+
+
+def _shard_map_kwargs(variant: str) -> dict:
+    """``pallas_call`` has no shard_map replication rule in this jax
+    (NotImplementedError at trace time), so the pallas variant disables
+    the replication check — sound here because every grouping kernel
+    psums its counts to an explicitly replicated output anyway."""
+    return {"check_rep": False} if variant == "pallas" else {}
+
+
 @lru_cache(maxsize=64)
-def _bincount_fn(num_segments: int, mesh):
+def _bincount_fn(num_segments: int, mesh, variant: str = "scatter"):
     """Jitted (and mesh-wrapped) bincount kernel, cached so repeated runs
-    with the same cardinality/mesh reuse the traced program instead of
-    retracing per call."""
+    with the same cardinality/mesh/kernel-variant reuse the traced
+    program instead of retracing per call (the variant is part of the
+    cache key — a one-hot program must never serve a scatter dispatch
+    or vice versa)."""
 
     def count(k):
         slot = jnp.where(k < 0, num_segments, k)
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(slot, dtype=jnp.int64), slot, num_segments=num_segments + 1
-        )
+        counts = _count_slots(slot, num_segments, variant)
         if mesh is not None:
             counts = jax.lax.psum(counts, ROW_AXIS)
         return counts
 
     if mesh is not None:
         return jax.jit(
-            shard_map(count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+            shard_map(
+                count, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P(),
+                **_shard_map_kwargs(variant),
+            )
         )
     return jax.jit(count)
 
 
 @lru_cache(maxsize=64)
-def _topk_fn(num_segments: int, kk: int, mesh, merge_null_into: int = -1):
-    """Jitted dense-count + device top-k kernel (cached like _bincount_fn).
-    ``merge_null_into`` as in _topk_from_counts_fn."""
+def _topk_fn(
+    num_segments: int, kk: int, mesh, merge_null_into: int = -1,
+    variant: str = "scatter",
+):
+    """Jitted dense-count + device top-k kernel (cached like _bincount_fn,
+    kernel variant in the cache key). ``merge_null_into`` as in
+    _topk_from_counts_fn."""
 
     def kernel(c):
         slot = jnp.where(c < 0, num_segments, c)
-        counts = jax.ops.segment_sum(
-            jnp.ones_like(slot, dtype=jnp.int64), slot,
-            num_segments=num_segments + 1,
-        )
+        counts = _count_slots(slot, num_segments, variant)
         if mesh is not None:
             counts = jax.lax.psum(counts, ROW_AXIS)
         counts = counts[:num_segments]
@@ -334,7 +380,10 @@ def _topk_fn(num_segments: int, kk: int, mesh, merge_null_into: int = -1):
 
     if mesh is not None:
         return jax.jit(
-            shard_map(kernel, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P())
+            shard_map(
+                kernel, mesh=mesh, in_specs=P(ROW_AXIS), out_specs=P(),
+                **_shard_map_kwargs(variant),
+            )
         )
     return jax.jit(kernel)
 
@@ -349,7 +398,8 @@ def _topk_fn(num_segments: int, kk: int, mesh, merge_null_into: int = -1):
 
 @lru_cache(maxsize=64)
 def _resident_bincount_fn(
-    num_segments: int, n_chunks: int, row: int, include_null: bool, mesh
+    num_segments: int, n_chunks: int, row: int, include_null: bool, mesh,
+    variant: str = "scatter",
 ):
     def kernel(*args):  # codes_0, rv_0, codes_1, rv_1, ...
         counts = jnp.zeros(num_segments + 1, dtype=jnp.int64)
@@ -358,10 +408,7 @@ def _resident_bincount_fn(
             rv = args[2 * i + 1]
             on = rv if include_null else rv & (c >= 0)
             slot = jnp.where(on, c + 1, num_segments)
-            counts = counts + jax.ops.segment_sum(
-                jnp.ones_like(slot, dtype=jnp.int64), slot,
-                num_segments=num_segments + 1,
-            )
+            counts = counts + _count_slots(slot, num_segments, variant)
         if mesh is not None:
             counts = jax.lax.psum(counts, ROW_AXIS)
         return counts[:num_segments]
@@ -369,7 +416,10 @@ def _resident_bincount_fn(
     if mesh is not None:
         in_specs = (P(None, ROW_AXIS), P(ROW_AXIS)) * n_chunks
         return jax.jit(
-            shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=P())
+            shard_map(
+                kernel, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                **_shard_map_kwargs(variant),
+            )
         )
     return jax.jit(kernel)
 
@@ -388,8 +438,14 @@ def _resident_string_bincount(table, column: str, include_null: bool, mesh):
         return None
     row = packer.string_names.index(column)
     card = len(packer.col_dict[column])
+    from deequ_tpu.ops.device_policy import resolve_hist_variant
+
+    variant = resolve_hist_variant((card + 2,), rows=table.num_rows)
+    # one bincount pass per resident chunk, all inside one dispatch
+    SCAN_STATS.record_hist_dispatch(variant, len(cache.device_chunks))
     fn = _resident_bincount_fn(
-        card + 1, len(cache.device_chunks), row, include_null, mesh
+        card + 1, len(cache.device_chunks), row, include_null, mesh,
+        variant,
     )
     args = []
     for chunk in cache.device_chunks:
@@ -455,7 +511,7 @@ def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
     land in an extra trailing slot that is dropped.
     """
     n = len(keys)
-    if n <= HOST_GROUP_LIMIT:
+    if n <= host_group_limit():
         # latency-dominated regime: host bincount (totals are identical —
         # the mesh merge only re-sums the same rows)
         slots = np.where(keys >= 0, keys, num_segments)
@@ -466,7 +522,13 @@ def _device_bincount(keys: np.ndarray, num_segments: int, mesh) -> np.ndarray:
     if padded != n:
         keys = np.concatenate([keys, np.full(padded - n, -1, dtype=np.int64)])
 
-    counts = np.asarray(_bincount_fn(num_segments, mesh)(keys))
+    # histogram kernel tier (round 14): scatter vs one-hot matmul vs
+    # pallas, resolved per dispatch from keyspace width / rows / platform
+    from deequ_tpu.ops.device_policy import resolve_hist_variant
+
+    variant = resolve_hist_variant((num_segments + 1,), rows=n)
+    SCAN_STATS.record_hist_dispatch(variant)
+    counts = np.asarray(_bincount_fn(num_segments, mesh, variant)(keys))
     _record_fetch(counts)
     return counts[:num_segments]
 
@@ -697,7 +759,7 @@ def group_top_k(
     num_segments = card + 1  # slot 0 = null group
     kk = min(k, num_segments)
 
-    if n <= HOST_GROUP_LIMIT:
+    if n <= host_group_limit():
         # latency-dominated regime: counts + top-k on host (identical
         # ordering: argsort(-counts) stable == top_k's rank order up to
         # count ties, which are unstable on both sides by contract)
@@ -717,9 +779,13 @@ def group_top_k(
             codes = np.concatenate(
                 [codes, np.full(padded - n, -1, dtype=np.int64)]
             )
+        from deequ_tpu.ops.device_policy import resolve_hist_variant
+
+        variant = resolve_hist_variant((num_segments + 1,), rows=n)
+        SCAN_STATS.record_hist_dispatch(variant)
         num_groups, top_counts, top_idx = (
             np.asarray(x)
-            for x in _topk_fn(num_segments, kk, mesh, nv_code)(codes)
+            for x in _topk_fn(num_segments, kk, mesh, nv_code, variant)(codes)
         )
         _record_fetch(num_groups, top_counts, top_idx)
 
@@ -828,7 +894,7 @@ def group_count_stats(
         if any_non_null is not None
         else np.ones(table.num_rows, dtype=bool)
     )
-    if table.num_rows <= HOST_GROUP_LIMIT:
+    if table.num_rows <= host_group_limit():
         # latency-dominated regime: _device_matrix_rle takes its host
         # path below this size — derive the stats from its counts
         _groups, counts = _device_matrix_rle(matrix, valid)

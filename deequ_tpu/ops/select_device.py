@@ -117,10 +117,19 @@ def inverse_monotone_u32(u, xp):
 
 
 def _segment_count(seg, num_segments: int, xp):
-    """Histogram of i32 segment ids (one scatter-add dispatch;
-    ``at[].add`` rather than segment_sum — same scatter, but without
-    materializing the all-ones operand, measured ~2x faster on CPU)."""
-    return xp.zeros((num_segments,), dtype=xp.int32).at[seg].add(1)
+    """Histogram of i32 segment ids under the routed kernel tier
+    (ops/histogram_device.py): the scatter variant traces ``at[].add``
+    exactly as before round 14 (``at[].add`` rather than segment_sum —
+    same scatter, but without materializing the all-ones operand,
+    measured ~2x faster on CPU); the one-hot/pallas variants replace
+    the scatter with a blocked matmul / Mosaic grid kernel. The ambient
+    variant is bound by the planner around the whole selection update
+    (ops/scan_plan._bind_hist_variant), so all three passes of one
+    summary trace the SAME kernel shape — the plan-hist-scatter lint
+    contract."""
+    from deequ_tpu.ops.histogram_device import bincount
+
+    return bincount(seg, num_segments, xp, dtype=xp.int32)
 
 
 def _bucket_of_rank(tcum, rank_rem, xp):

@@ -828,8 +828,12 @@ def test_oom_mid_fold_restarts_device_accumulator_cleanly():
         ):
             np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
     # the retry's fetch is the scan's only one (the aborted attempt's
-    # accumulator was discarded, never drained)
-    assert SCAN_STATS.device_fetches == 1
+    # accumulator was discarded, never drained). Read through the
+    # SYNCHRONIZED snapshot: the historical flake here was a late-waking
+    # watchdog-abandoned worker from an EARLIER suite bumping the
+    # process-global counter mid-test — record_fetch now drops abandoned
+    # calls' fetches and snapshot() reads the ledger under its lock
+    assert SCAN_STATS.snapshot()["device_fetches"] == 1
 
 
 def test_fused_resident_scan_survives_injected_oom():

@@ -178,8 +178,9 @@ def _sorting_drift(monkeypatch):
     still declares variant='select'."""
     real = plan_scan_ops
 
-    def drifted(ops, packer=None, resident=False, select_kernel=None):
-        plan = real(ops, packer, resident, select_kernel)
+    def drifted(ops, packer=None, resident=False, select_kernel=None,
+                rows=None):
+        plan = real(ops, packer, resident, select_kernel, rows)
         if plan.variant != "select":
             return plan
         new_ops = []
@@ -214,7 +215,8 @@ def test_select_variant_with_sort_primitive_rejected(monkeypatch):
 def test_mis_tagged_fold_leaf_rejected_pre_dispatch(monkeypatch):
     real = plan_scan_ops
 
-    def mistagged(ops, packer=None, resident=False, select_kernel=None):
+    def mistagged(ops, packer=None, resident=False, select_kernel=None,
+                  rows=None):
         plan = real(ops, packer, resident, select_kernel)
         corrupted = tuple(
             tuple("max" if t == "sum" else t for t in tags)
@@ -261,7 +263,8 @@ def test_plan_lint_error_raises_through_streaming_runner(
     never lands as a failure metric."""
     real = plan_scan_ops
 
-    def mistagged(ops, packer=None, resident=False, select_kernel=None):
+    def mistagged(ops, packer=None, resident=False, select_kernel=None,
+                  rows=None):
         plan = real(ops, packer, resident, select_kernel)
         corrupted = tuple(
             tuple("max" if t == "sum" else t for t in tags)
