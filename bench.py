@@ -1384,6 +1384,341 @@ def measure_fleet_failover(n_tenants: int, n_workers: int = 4):
     }
 
 
+def measure_overload_shedding(n_submissions: int = 2400):
+    """Overload-tier probe (round 15, deequ_tpu/serve/admission.py —
+    the ROADMAP item-1 per-tenant-SLO acceptance shape): the 4-worker
+    forced-host fleet under paced OPEN-LOOP load — first at ~0.5x its
+    measured unloaded capacity, then at ~2x — with every submission
+    carrying a real SLO class (25% critical, 25% standard with no
+    deadline, 50% best_effort with a tight one).
+
+    Contract asserts (the probe REFUSES to report on violation, like
+    the serving/fleet/one-fetch asserts):
+
+    - ZERO SHEDS AT <= 0.5x: the paced half-load pass must complete
+      every submission (no deadline sheds, no admission refusals) —
+      the overload tier must be INERT when there is no overload;
+    - CRITICAL SURVIVES 2x: under ~2x open-loop overload, zero
+      critical-class sheds and critical p99 submit->resolve latency
+      within its SLO deadline (strict class priority + reserved
+      admission headroom are what buy this);
+    - BEST_EFFORT SHEDS TYPED: the 2x pass must shed best_effort
+      requests pre-dispatch as typed ``DeadlineExceededException``
+      resolutions on their original futures (exactly once each);
+    - GOODPUT HOLDS: completed suites/sec of the 2x pass >= 0.8x the
+      unloaded capacity — shedding is cheap, and the work that runs is
+      the work that still has a caller. "Unloaded capacity" is
+      CALIBRATED by the same open-loop pacing harness the load passes
+      use (the highest paced rate with flat p95 and zero sheds): a
+      closed-loop deep-queue rate would overstate what any arrival
+      process can reach and understate per-arrival costs;
+    - BIT-IDENTITY: every COMPLETED result of the overload pass equals
+      its tenant's unloaded serial run bit for bit — brownout/shedding
+      change WHICH requests run, never how;
+    - CHAOS QUICK-SOAK CLEAN: a 4-seed ``load``-seam chaos soak
+      (scripted spikes + slow-tenant stalls) reports zero oracle
+      violations (exactly-once incl. typed sheds, no priority
+      inversion)."""
+    import struct
+
+    from deequ_tpu import VerificationSuite
+    from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.exceptions import (
+        DeadlineExceededException,
+        ServiceOverloadedException,
+    )
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.resilience.chaos import soak
+    from deequ_tpu.serve import Slo, VerificationFleet
+
+    CRITICAL_DEADLINE_MS = 5_000.0
+    BEST_EFFORT_DEADLINE_MS = 500.0
+    #: the calibration ramp's stability bar: a rate counts as
+    #: sustainable only while paced p95 latency stays under this and
+    #: nothing sheds (well under the tightest deadline, so the half
+    #: pass inherits a ~8x margin; tight on purpose — a generous bar
+    #: admits rates already trading latency for throughput, and 2x of
+    #: THOSE is a submission storm that measures the pacing thread's
+    #: GIL contention, not the admission tier)
+    CALIBRATION_P95_S = 0.12
+    N_TENANTS = 4  # distinct row counts -> distinct digests -> ring spread
+
+    def analyzers():
+        return [Size(), Completeness("x"), Mean("x"), Sum("i")]
+
+    def tenant_table(t: int):
+        r = np.random.default_rng(9000 + t)
+        # ~16k rows per suite: enough device compute per dispatch that
+        # the fleet's hard service ceiling sits well BELOW what one
+        # pacing thread can emit — 2x of the calibrated rate is then a
+        # genuine arrival-rate overload, not a GIL-starved submit storm
+        n = 16384 + 2048 * t
+        return ColumnarTable([
+            Column("x", DType.FRACTIONAL, values=r.normal(100, 5, n),
+                   mask=r.random(n) > 0.05),
+            Column("i", DType.INTEGRAL,
+                   values=r.integers(0, 50, n).astype(np.float64),
+                   mask=np.ones(n, bool)),
+        ])
+
+    tables = [tenant_table(t) for t in range(N_TENANTS)]
+    # the load mix, cycled round-robin so every pacing window carries
+    # every class: 25% critical, 25% standard, 50% best_effort
+    def slo_of(t: int) -> Slo:
+        if t == 0:
+            return Slo(deadline_ms=CRITICAL_DEADLINE_MS, cls="critical")
+        if t == 1:
+            return Slo(cls="standard")
+        return Slo(deadline_ms=BEST_EFFORT_DEADLINE_MS, cls="best_effort")
+
+    def bits(v):
+        return struct.pack("<d", v) if isinstance(v, float) else v
+
+    def submit_one(fleet, i):
+        t = i % N_TENANTS
+        return t, fleet.submit(
+            tables[t], required_analyzers=analyzers(),
+            tenant=f"t{t}", slo=slo_of(t),
+        )
+
+    def paced_pass(fleet, rate, count):
+        """Open-loop: submit ``count`` suites at ``rate``/s (absolute
+        schedule, no waiting on results), then gather every future.
+        Returns (wall from first submit to last resolution, outcomes)
+        where outcomes is a list of (tenant, slo_class, future|None,
+        refusal|None)."""
+        interval = 1.0 / rate
+        out = []
+        t0 = time.time()
+        for i in range(count):
+            lag = (t0 + i * interval) - time.time()
+            if lag > 0:
+                time.sleep(lag)
+            t = i % N_TENANTS
+            try:
+                _, future = submit_one(fleet, i)
+                out.append((t, slo_of(t).cls, future, None))
+            except ServiceOverloadedException as e:
+                out.append((t, slo_of(t).cls, None, e))
+        for _, _, future, _ in out:
+            if future is not None:
+                try:
+                    future.result(timeout=600)
+                except Exception:  # noqa: BLE001 — outcomes categorized below
+                    pass
+        return time.time() - t0, out
+
+    def categorize(outcomes):
+        ok, shed, refused, failed = [], [], [], []
+        for t, cls, future, refusal in outcomes:
+            if refusal is not None:
+                refused.append((t, cls, refusal))
+            elif not future.done():
+                # gather timed out on an unresolved future: an orphan
+                # is THE bug this probe exists to catch — report it as
+                # a failure, don't crash the ok-path asserts on it
+                failed.append((t, cls, TimeoutError(
+                    f"future for t{t}/{cls} never resolved (orphan)"
+                )))
+            elif isinstance(future._error, DeadlineExceededException):
+                shed.append((t, cls, future))
+            elif future._error is not None:
+                failed.append((t, cls, future._error))
+            else:
+                ok.append((t, cls, future))
+        return ok, shed, refused, failed
+
+    with use_mesh(None):
+        serial_ref = {
+            t: VerificationSuite.run(
+                tables[t], [], required_analyzers=analyzers()
+            )
+            for t in range(N_TENANTS)
+        }
+        # the chaos fleet shape: 4 forced-host workers sharing one
+        # compile cache (membership off — overload is not death)
+        fleet = VerificationFleet(
+            n_workers=4, monitor=False, distinct_devices=False,
+            worker_knobs={"coalesce_window": 0.01},
+        )
+        try:
+            # warm every plan AND every pow2 tenant-width bucket the
+            # load can pop (width-bucket programs compile per shape):
+            # width w is warmed by submitting exactly w copies of one
+            # plan back-to-back so they coalesce into a w-wide dispatch
+            for width in (1, 1, 2, 4, 8, 16):
+                for t in range(N_TENANTS):
+                    warm = [
+                        fleet.submit(
+                            tables[t], required_analyzers=analyzers(),
+                            tenant=f"t{t}",
+                        )
+                        for _ in range(width)
+                    ]
+                    for f in warm:
+                        f.result(timeout=600)
+            fleet.prewarm()
+
+            # -- calibrate the UNLOADED OPEN-LOOP capacity: ramp the
+            # paced rate until p95 latency degrades or anything sheds.
+            # A closed-loop deep-queue rate is NOT the right
+            # denominator here — with the whole load pre-queued the
+            # coalescer runs max-width batches no paced arrival
+            # process reaches, and on a shared-vCPU container the
+            # pacing thread itself contends with the workers — so
+            # "capacity" is the highest ARRIVAL rate the fleet serves
+            # with flat latency, measured by the same pacing harness
+            # the load passes use.
+            capacity = None
+            rate = 50.0
+            retried = False
+            while rate <= 1200.0:
+                # each rung sustains its rate for ~0.8s of wall: a
+                # short burst absorbs into the queue and reads as
+                # sustainable no matter the rate
+                wall, out = paced_pass(
+                    fleet, rate=rate, count=int(max(96, rate * 0.8))
+                )
+                ok, shed, refused, failed = categorize(out)
+                lats = sorted(
+                    f.latency_seconds for _, _, f, _ in out
+                    if f is not None and f.latency_seconds is not None
+                )
+                p95 = lats[min(len(lats) - 1, int(0.95 * len(lats)))]
+                if shed or refused or failed or p95 > CALIBRATION_P95_S:
+                    # one retry per ramp: a scheduler stall can fail a
+                    # genuinely sustainable rung, under-calibrating
+                    # capacity so far that 2x of it never overloads
+                    if not retried:
+                        retried = True
+                        time.sleep(0.5)
+                        continue
+                    break
+                capacity = rate
+                rate *= 1.5
+            assert capacity is not None, (
+                "overload violation: the fleet cannot sustain even "
+                "50 paced suites/s unloaded — no capacity to gate "
+                "shedding against"
+            )
+
+            # -- <= 0.5x: the overload tier must be inert. One retry:
+            # on a shared-vCPU container a single scheduler stall can
+            # blow one pass's latencies through a deadline — a real
+            # inertness regression fails BOTH passes
+            half_count = min(n_submissions // 2, 240)
+            for attempt in (0, 1):
+                half_wall, half_out = paced_pass(
+                    fleet, rate=0.5 * capacity, count=half_count
+                )
+                ok, shed, refused, failed = categorize(half_out)
+                if not (shed or refused or failed) or attempt:
+                    break
+                time.sleep(0.5)
+            assert not failed, (
+                f"overload violation: {len(failed)} untyped/unexpected "
+                f"failures at half load: {failed[:3]}"
+            )
+            assert not shed and not refused, (
+                f"overload violation: {len(shed)} sheds + {len(refused)} "
+                "admission refusals at <= 0.5x load — the overload tier "
+                "must be inert without overload"
+            )
+
+            # -- ~2x open-loop overload, long enough that queue wait
+            # outgrows the best_effort deadline (backlog accrues at
+            # (offered - served) per wall second)
+            over_count = int(min(
+                max(400, 2.0 * capacity * 3.0), max(n_submissions, 400),
+            ))
+            over_wall, over_out = paced_pass(
+                fleet, rate=2.0 * capacity, count=over_count
+            )
+            ok, shed, refused, failed = categorize(over_out)
+            assert not failed, (
+                f"overload violation: {len(failed)} untyped/unexpected "
+                f"failures under 2x overload: {failed[:3]}"
+            )
+            crit_shed = [s for s in shed if s[1] == "critical"]
+            be_shed = [s for s in shed if s[1] == "best_effort"]
+            assert not crit_shed, (
+                f"overload violation: {len(crit_shed)} critical-class "
+                "requests shed under 2x overload — strict class priority "
+                "+ reserved admission headroom must keep critical clean"
+            )
+            assert be_shed, (
+                "overload violation: 2x open-loop overload shed zero "
+                "best_effort requests — the deadline-aware queue never "
+                "engaged (not actually overloaded, or sheds are broken)"
+            )
+            exactly_once = [
+                f for _, _, f, r in over_out
+                if f is not None and f.resolve_count != 1
+            ]
+            assert not exactly_once, (
+                f"overload violation: {len(exactly_once)} accepted "
+                "futures resolved != exactly once under overload"
+            )
+            crit_lat = sorted(
+                f.latency_seconds for t, cls, f in ok if cls == "critical"
+            )
+            assert crit_lat, "no critical completions under overload"
+            crit_p99 = crit_lat[min(len(crit_lat) - 1,
+                                    int(0.99 * len(crit_lat)))]
+            assert crit_p99 * 1000 <= CRITICAL_DEADLINE_MS, (
+                f"overload violation: critical p99 {crit_p99 * 1000:.0f}ms "
+                f"exceeded its {CRITICAL_DEADLINE_MS:g}ms SLO under 2x "
+                "overload"
+            )
+            goodput = len(ok) / max(over_wall, 1e-9)
+            assert goodput >= 0.8 * capacity, (
+                f"overload violation: goodput {goodput:.1f} suites/s under "
+                f"2x overload is below 0.8x the unloaded capacity "
+                f"({capacity:.1f}) — shedding must protect throughput, "
+                "not replace it"
+            )
+            for t, cls, future in ok:
+                served, serial = future._result, serial_ref[t]
+                assert str(served.status) == str(serial.status), (t, cls)
+                for a, m1 in serial.metrics.items():
+                    m2 = served.metrics[a]
+                    assert m1.value.is_success and m2.value.is_success, (t, a)
+                    assert bits(m1.value.get()) == bits(m2.value.get()), (
+                        f"overload violation: tenant t{t} {a} under load "
+                        f"{m2.value.get()!r} != unloaded serial "
+                        f"{m1.value.get()!r} — overload must never degrade "
+                        "computation"
+                    )
+        finally:
+            fleet.stop(drain=True)
+
+    # chaos load-seam quick-soak: scripted spikes + slow-tenant stalls,
+    # zero oracle violations (exactly-once incl. typed sheds, no
+    # priority inversion)
+    soak_summary = soak(n=4, seed0=0, verbose=False, load=True)
+    assert soak_summary["failures"] == [], (
+        "overload violation: the chaos load-seam quick-soak reported "
+        f"oracle violations: {soak_summary['failures']}"
+    )
+
+    return {
+        "overload_goodput_frac": round(goodput / capacity, 3),
+        "overload_unloaded_suites_per_sec": round(capacity, 1),
+        "overload_goodput_suites_per_sec": round(goodput, 1),
+        "overload_offered_x": 2.0,
+        "overload_submissions": over_count,
+        "overload_completed": len(ok),
+        "overload_shed_best_effort": len(be_shed),
+        "overload_shed_critical": 0,
+        "overload_refused_typed": len(refused),
+        "overload_critical_p99_ms": round(crit_p99 * 1000, 2),
+        "overload_critical_slo_ms": CRITICAL_DEADLINE_MS,
+        "overload_halfload_sheds": 0,
+        "overload_chaos_load_soak": soak_summary["outcomes"],
+    }
+
+
 def measure_repository_query(n_tenants: int, n_dates: int = 32):
     """Repository-query probe (round 13, deequ_tpu/repository — ROADMAP
     item 5's acceptance shape): an ``n_tenants x n_dates`` metric

@@ -187,6 +187,29 @@ def config9():
     )
 
 
+def config10(n_submissions: int):
+    """OVERLOAD config (round 15, deequ_tpu/serve/admission.py): the
+    config-7 fleet under paced open-loop load — ~0.5x then ~2x its own
+    measured unloaded capacity — with every submission carrying an SLO
+    class. ONE workload definition, shared with bench.py's
+    ``measure_overload_shedding`` probe, which hard-asserts — before it
+    reports anything — zero sheds at <= 0.5x load, zero critical sheds
+    + critical p99 within its SLO under 2x, typed best_effort sheds,
+    goodput >= 0.8x unloaded capacity, bit-identity of every completed
+    result vs the unloaded serial run, and a clean 4-seed chaos
+    ``load``-seam quick-soak (exactly-once incl. typed sheds, no
+    priority inversion)."""
+    import bench
+
+    probe = bench.measure_overload_shedding(n_submissions)
+    return _emit(
+        config=10, metric="overload_goodput_frac",
+        submissions=n_submissions,
+        value=probe["overload_goodput_frac"], unit="x vs unloaded",
+        **{k: v for k, v in probe.items() if k != "overload_goodput_frac"},
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -713,6 +736,11 @@ def main():
         # (scatter vs one-hot matmul vs pallas) with exactness /
         # plan-lint / one-fetch / no-regression gates asserted inside
         9: lambda: config9(),
+        # round-15 overload config: the SLO-classed fleet under 0.5x /
+        # 2x paced open-loop load (zero-shed-when-unloaded, critical-
+        # survives, typed best_effort sheds, goodput, bit-identity, and
+        # the chaos load quick-soak asserted inside)
+        10: lambda: config10(args.rows or 2400),
     }
     if args.all:
         for k in sorted(runners):
@@ -725,7 +753,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5,6,7,8,9} or --all")
+        ap.error("--config {1,2,3,4,5,6,7,8,9,10} or --all")
 
 
 if __name__ == "__main__":

@@ -247,6 +247,23 @@ SERVE_COALESCE_WINDOW = register(EnvVar(
     minimum=0.0,
     doc="seconds the serve worker waits for co-batchable submissions",
 ))
+SLO_CLASS = register(EnvVar(
+    "DEEQU_TPU_SLO_CLASS", "choice", default="standard",
+    choices=("critical", "standard", "best_effort"),
+    doc="default SLO class for submissions that carry none "
+        "(serve/admission.py, PR 15)",
+))
+SLO_DEADLINE_MS = register(EnvVar(
+    "DEEQU_TPU_SLO_DEADLINE_MS", "float", default=None, zero_disables=True,
+    doc="default absolute submit->dispatch deadline (ms) for submissions "
+        "that carry no SLO; expired requests shed typed pre-dispatch "
+        "(unset/0 = no deadline)",
+))
+BROWNOUT = register(EnvVar(
+    "DEEQU_TPU_BROWNOUT", "flag01", default=True,
+    doc="0 disables the serving brownout ladder (admission-side load "
+        "shedding by SLO class; computation is never degraded)",
+))
 FLEET_WORKERS = register(EnvVar(
     "DEEQU_TPU_FLEET_WORKERS", "int", default=None, minimum=1,
     doc="VerificationFleet worker count (PR 12; unset = one per device, "
@@ -265,6 +282,13 @@ REPO_SEGMENT_ROWS = register(EnvVar(
     "DEEQU_TPU_REPO_SEGMENT_ROWS", "int", default=4096, minimum=1,
     doc="target scalar-metric rows per compacted columnar-repository "
         "append segment (repository/columnar.py)",
+))
+REPO_TTL = register(EnvVar(
+    "DEEQU_TPU_REPO_TTL", "float", default=None, zero_disables=True,
+    doc="retention window for the columnar metrics repository, in "
+        "dataset-date units (the ResultKey.dataset_date axis): at "
+        "compaction, results older than (newest live date - TTL) are "
+        "dropped (unset/0 = keep everything)",
 ))
 MONITOR = register(EnvVar(
     "DEEQU_TPU_MONITOR", "flag01", default=True,

@@ -109,9 +109,68 @@ class ServiceClosedException(ServeException):
 
 
 class ServiceOverloadedException(ServeException):
-    """Typed backpressure: the service's pending queue is at
-    ``max_pending`` — the caller sheds load or retries later; the
-    service never buffers without bound."""
+    """Typed backpressure: the service refused to buffer this request —
+    the pending queue is at ``max_pending``, or (round 15, the admission
+    tier's subclasses below) the request's SLO class ran out of budget
+    or its deadline expired in-queue. The caller sheds load or retries
+    after ``retry_after_s``; the service never buffers without bound.
+
+    Structured fields (all optional — pre-round-15 raise sites carried a
+    message only): ``queue_depth`` is the pending count at refusal,
+    ``retry_after_s`` the service's drain-rate-derived estimate of when
+    a retry could be admitted, ``slo_class`` the refused request's SLO
+    class (``"critical"`` | ``"standard"`` | ``"best_effort"``)."""
+
+    def __init__(self, message: str, queue_depth: Optional[int] = None,
+                 retry_after_s: Optional[float] = None,
+                 slo_class: Optional[str] = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+        self.slo_class = slo_class
+
+
+class AdmissionRejectedException(ServiceOverloadedException):
+    """The admission controller (deequ_tpu/serve/admission.py) refused
+    this request at ``submit()``: its SLO class's queue budget is
+    exhausted, the brownout ladder is shedding its class (level 1 sheds
+    ``best_effort``, level 3 admits ``critical`` only), or its tenant is
+    over the brownout inflight cap (level 2). ``reason`` names which
+    (``"class_budget"`` | ``"brownout_best_effort"`` |
+    ``"brownout_critical_only"`` | ``"tenant_inflight_cap"``);
+    ``retry_after_s`` is always populated — admission rejection is
+    backpressure with a schedule, not an error."""
+
+    def __init__(self, message: str, reason: str = "class_budget",
+                 queue_depth: Optional[int] = None,
+                 retry_after_s: Optional[float] = None,
+                 slo_class: Optional[str] = None):
+        super().__init__(message, queue_depth=queue_depth,
+                         retry_after_s=retry_after_s, slo_class=slo_class)
+        self.reason = reason
+
+
+class DeadlineExceededException(ServiceOverloadedException):
+    """An ACCEPTED request's absolute SLO deadline expired before its
+    dispatch: the deadline-aware queue sheds it pre-dispatch (resolved
+    exactly once, typed, on its original future) instead of burning
+    device time on a result whose caller already gave up — and a fleet
+    failover re-dispatch sheds an expired victim the same way rather
+    than replaying it stale. ``waited_s`` is how long the request sat
+    accepted; ``deadline_ms`` the SLO it missed. Computation is never
+    degraded — only which requests run."""
+
+    def __init__(self, message: str, tenant=None,
+                 slo_class: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 waited_s: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message, queue_depth=queue_depth,
+                         retry_after_s=retry_after_s, slo_class=slo_class)
+        self.tenant = tenant
+        self.deadline_ms = deadline_ms
+        self.waited_s = waited_s
 
 
 class RetryExhaustedException(MetricCalculationRuntimeException):

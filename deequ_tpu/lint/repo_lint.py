@@ -100,6 +100,10 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # directly on traced device seams, so the host-fetch / bare-except /
     # typed-raise disciplines apply in full — a swallowed availability
     # probe there would silently reroute every histogram to scatter.
+    # The round-15 overload tier (serve/admission.py) rides the serve/
+    # prefix unchanged: admission refusals and deadline sheds MUST stay
+    # typed (a bare except around a shed would orphan the future it was
+    # about to resolve), so all three disciplines apply in full.
     "host-fetch": (
         "ops/", "parallel/", "anomaly/", "serve/", "obs/", "repository/",
     ),

@@ -478,6 +478,47 @@ SERVE_REJECTED = REGISTRY.counter(
     "serve_suites_rejected", "futures rejected with a typed error"
 )
 
+#: the admission tier's per-SLO-class instruments (serve/admission.py,
+#: round 15): admissions, admission-time rejections (class budget /
+#: brownout), and in-queue deadline sheds — per class because the whole
+#: point of SLO classes is that these three diverge under overload
+#: (best_effort sheds while critical stays clean)
+_SLO_CLASSES = ("critical", "standard", "best_effort")
+SERVE_ADMITTED_BY_CLASS = {
+    cls: REGISTRY.counter(
+        f"serve_admitted_{cls}",
+        f"{cls}-class submissions accepted by the admission controller",
+    )
+    for cls in _SLO_CLASSES
+}
+SERVE_ADMISSION_REJECTED_BY_CLASS = {
+    cls: REGISTRY.counter(
+        f"serve_admission_rejected_{cls}",
+        f"{cls}-class admission decisions refused typed (queue full / "
+        "class budget / brownout / inflight cap) — counted per "
+        "PER-WORKER decision, so one fleet submission spilled past k "
+        "refusing workers counts k refusals (and one admission where "
+        "it lands)",
+    )
+    for cls in _SLO_CLASSES
+}
+SERVE_SHED_BY_CLASS = {
+    cls: REGISTRY.counter(
+        f"serve_shed_{cls}",
+        f"accepted {cls}-class requests shed typed pre-dispatch "
+        "(in-queue deadline expiry, incl. at fleet failover)",
+    )
+    for cls in _SLO_CLASSES
+}
+SERVE_BROWNOUT_LEVEL = REGISTRY.gauge(
+    "serve_brownout_level",
+    "brownout ladder level of the most recent per-service transition "
+    "(0 = healthy, 1 = shed best_effort admissions, 2 = + per-tenant "
+    "inflight cap, 3 = critical only). Exact for a single service; in "
+    "a fleet this is last-writer-wins across workers — read the fleet "
+    "section's per-worker brownout_level for the true per-worker view",
+)
+
 
 # -- the fleet tier's owned instruments (serve/fleet.py; the "fleet"
 #    collector section — per-worker queue depths + the hot-plan feed —
@@ -510,6 +551,17 @@ def _serve_section() -> dict:
         ),
         "latency": lat,
         "latency_tenants": len(SERVE_LATENCY.labels()),
+        "brownout_level": SERVE_BROWNOUT_LEVEL.snapshot(),
+        "admitted_by_class": {
+            cls: c.value for cls, c in SERVE_ADMITTED_BY_CLASS.items()
+        },
+        "admission_rejected_by_class": {
+            cls: c.value
+            for cls, c in SERVE_ADMISSION_REJECTED_BY_CLASS.items()
+        },
+        "shed_by_class": {
+            cls: c.value for cls, c in SERVE_SHED_BY_CLASS.items()
+        },
     }
 
 

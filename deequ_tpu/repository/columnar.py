@@ -19,7 +19,10 @@ a columnar table the engine can verify:
   result), never O(history) — the fs backend's quadratic wall is gone.
   Same-key re-saves append a superseding segment (last write wins, like
   the reference); ``compact()`` batches live results into
-  ``DEEQU_TPU_REPO_SEGMENT_ROWS``-row segments and drops dead ones.
+  ``DEEQU_TPU_REPO_SEGMENT_ROWS``-row segments and drops dead ones —
+  plus, with ``DEEQU_TPU_REPO_TTL`` armed, results wholly older than
+  (newest live dataset date - TTL): retention is a compaction policy,
+  so loader bit-identity holds unchanged over the surviving window.
 - **loader bit-identity**: ``load()`` / ``load_by_key`` decode segments
   back into :class:`AnalysisResult`s through the SAME
   ``MetricsRepositoryMultipleResultsLoader`` DSL — scalar values ride
@@ -100,6 +103,7 @@ class _RepoStats:
         self.bytes_appended = 0
         self.compactions = 0
         self.dead_results_dropped = 0
+        self.ttl_dropped = 0
         self.torn_segments_dropped = 0
         self.nonserializable_dropped = 0
         self.queries = 0
@@ -431,6 +435,7 @@ class ColumnarMetricsRepository(MetricsRepository):
         on_torn_segment: str = "raise",
         monitor=None,
         retry=None,
+        ttl: Optional[float] = None,
     ):
         if on_torn_segment not in ("raise", "recover"):
             raise ValueError(
@@ -444,6 +449,18 @@ class ColumnarMetricsRepository(MetricsRepository):
         if int(segment_rows) < 1:
             raise ValueError(f"segment_rows must be >= 1, got {segment_rows}")
         self.segment_rows = int(segment_rows)
+        # retention window (round 15, ROADMAP item-5 leftover): results
+        # older than (newest live dataset date - ttl) drop at COMPACTION
+        # — never on the load path, so a reader between compactions
+        # still sees exactly what the last compaction kept. None (the
+        # DEEQU_TPU_REPO_TTL default) keeps everything.
+        if ttl is None:
+            from deequ_tpu.envcfg import env_value
+
+            ttl = env_value("DEEQU_TPU_REPO_TTL")
+        if ttl is not None and not float(ttl) > 0:
+            raise ValueError(f"ttl must be > 0 dataset-date units, got {ttl}")
+        self.ttl = None if ttl is None else float(ttl)
         self.on_torn_segment = on_torn_segment
         self.monitor = monitor
         self._lock = threading.RLock()
@@ -610,20 +627,38 @@ class ColumnarMetricsRepository(MetricsRepository):
 
     def compact(self) -> int:
         """Rewrite the live history into batched segments of up to
-        ``segment_rows`` rows each and drop superseded results. Returns
-        the number of dead results dropped. Crash-safe: new segments are
-        written (atomic, fresh sequence numbers) before old files are
-        deleted — a crash mid-compaction leaves a replayable superset
-        whose last-write-wins replay yields the same live set."""
+        ``segment_rows`` rows each and drop superseded results — plus,
+        with a ``ttl`` armed, results wholly older than (newest live
+        dataset date - ttl). Returns the total results dropped (dead +
+        TTL-expired). Crash-safe: new segments are written (atomic,
+        fresh sequence numbers) before old files are deleted — a crash
+        mid-compaction leaves a replayable superset whose
+        last-write-wins replay yields the same live set."""
         with self._lock:
             return self._compact_locked()
 
     def _compact_locked(self) -> int:
-        dropped = self._dead_results
+        dead = self._dead_results
+        dropped = dead
         live = [
             self._segments[seg_idx].decode_results()[ridx]
             for seg_idx, ridx in self._live.values()
         ]
+        if self.ttl is not None and live:
+            # retention: the horizon trails the NEWEST live result (not
+            # the wall clock — dataset dates are the caller's axis), so
+            # an idle repository never silently empties itself
+            horizon = max(
+                r.result_key.data_set_date for r in live
+            ) - self.ttl
+            kept = [
+                r for r in live if r.result_key.data_set_date >= horizon
+            ]
+            expired = len(live) - len(kept)
+            if expired:
+                REPO_STATS.ttl_dropped += expired
+                dropped += expired
+                live = kept
         old_files = [s.file for s in self._segments if s.file is not None]
         # batch by rows: a result's scalar-row count decides the split
         batches: List[List[AnalysisResult]] = []
@@ -670,7 +705,7 @@ class ColumnarMetricsRepository(MetricsRepository):
                 except Exception:  # noqa: BLE001
                     pass
         REPO_STATS.compactions += 1
-        REPO_STATS.dead_results_dropped += dropped
+        REPO_STATS.dead_results_dropped += dead
         return dropped
 
     # -- the history table (query substrate) -----------------------------

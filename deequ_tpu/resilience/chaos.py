@@ -22,7 +22,16 @@ existing injector seam into one timeline —
   :class:`~deequ_tpu.serve.fleet.VerificationFleet`. A schedule with
   any worker event runs the FLEET scenario instead of the streaming
   one: the same batch partition becomes per-tenant suites submitted in
-  waves to a 4-worker fleet, with the events applied between waves —
+  waves to a 4-worker fleet, with the events applied between waves;
+- ``load``  — overload faults (round 15, the admission tier): scripted
+  OPEN-LOOP SPIKES (a flood tenant bursts tight-deadline best_effort
+  submissions mid-wave, no pacing) and SLOW-TENANT stalls (the worker
+  a tenant routes to wedges briefly — queue depth builds, deadlines
+  expire) over the same 4-worker fleet scenario, with every wave
+  submission carrying a real SLO class (t0 critical, t1/t2 standard,
+  t3 best_effort). No worker dies: the seam fuzzes admission control,
+  the deadline-aware fair queue, and the brownout ladder, not
+  failover —
 
 run one governed verification under it (``on_batch_error="skip"``,
 ``on_device_error="fallback"``, a `RunPolicy` budget), and then check the
@@ -48,12 +57,23 @@ system's OWN cross-cutting invariants as oracles:
 8. exactly-once futures (worker seam) — every future the fleet accepted
    resolves exactly once (a result or a typed error): none orphaned by
    a dead worker, none double-resolved by a stalled worker waking after
-   its requests failed over (``VerificationFuture.resolve_count``).
+   its requests failed over (``VerificationFuture.resolve_count``);
+9. exactly-once under overload (load seam) — every future the fleet
+   ACCEPTED (admission refusals raise typed at submit and mint no
+   future) still resolves exactly once, where an in-queue deadline
+   SHED — a typed ``DeadlineExceededException`` on the original
+   future — counts as a resolution: overload may change a request's
+   outcome, never orphan or double-resolve it;
+10. no priority inversion (load seam) — no ``critical`` request is
+   shed while a same-plan ``best_effort`` request DISPATCHED on the
+   same worker: a best_effort that resolved successfully before a
+   co-queued critical's shed popped while that critical still waited,
+   which the class-tiered queue's strict priority forbids.
 
 Worker-seam schedules check oracles 1/2/3/5/8 (the streaming-specific
 row-accounting and fetch/ledger oracles have no fleet analogue — a
 tenant's suite either completes bit-identically after failover or
-rejects typed).
+rejects typed); load-seam schedules check 1/2/3/9/10.
 
 A failing schedule is reduced by :func:`shrink_schedule` — classic
 delta debugging (ddmin) over the event list, re-running the oracles per
@@ -101,7 +121,7 @@ HANG_SECONDS = 0.6
 TERMINATION_SLACK = 2.0
 
 _SCAN_KINDS = ("oom", "compile", "lost", "hang")
-_SEAMS = ("scan", "batch", "staging", "fs", "worker")
+_SEAMS = ("scan", "batch", "staging", "fs", "worker", "load")
 
 #: fleet scenario geometry (worker seam): the scenario table splits into
 #: one slice per tenant, each submitted once per wave; worker events
@@ -125,6 +145,26 @@ FLEET_STALL_TIMEOUT = 0.3
 #: failover runs while it sleeps; when it wakes, its late resolutions
 #: are dropped (oracle 8 watches the count)
 WORKER_STALL_SECONDS = 0.8
+
+#: load-seam (round 15) scenario geometry: the same 4-tenant slices,
+#: each wave submission carrying an SLO class — t0 is the critical
+#: tenant (generous deadline: it must survive anything the seam
+#: scripts), t1/t2 standard, t3 best_effort with a deadline tight
+#: enough that scripted stalls expire it in-queue
+LOAD_TENANT_SLO = (
+    ("critical", 20_000.0),
+    ("standard", 10_000.0),
+    ("standard", 10_000.0),
+    ("best_effort", 1_500.0),
+)
+_LOAD_KINDS = ("spike", "slow_tenant")
+#: spike submissions (the flood tenant's open-loop burst) are
+#: best_effort with a deadline this tight — under the stall-built queue
+#: most of a burst expires pre-dispatch, which is the point
+LOAD_SPIKE_DEADLINE_MS = 500.0
+#: per-worker queue bound for the load scenario: small enough that a
+#: scripted burst reaches admission pressure (class budgets, brownout)
+LOAD_MAX_PENDING = 24
 
 
 def _fast_retry():
@@ -316,6 +356,49 @@ class ChaosSchedule:
             seed=seed, events=tuple(events), run_deadline=30.0,
         )
 
+    @staticmethod
+    def generate_load(seed: int) -> "ChaosSchedule":
+        """Seeded LOAD-seam schedule (round 15): scripted open-loop
+        spikes and slow-tenant stalls over the SLO-classed fleet
+        scenario. Spikes name the tenant whose table floods (sharing
+        its routing digest — so a spike on t0 co-queues best_effort
+        floods with critical wave traffic, exactly what the
+        no-priority-inversion oracle watches); slow_tenant events
+        wedge the named tenant's placed worker briefly (queue depth
+        builds, deadlines expire — load, not death: membership stays
+        off and nothing fails over)."""
+        rng = Random(seed)
+        events: List[dict] = []
+        for wave in range(FLEET_WAVES):
+            if events and rng.random() < 0.3:
+                continue
+            tenant = rng.randrange(len(FLEET_TENANT_ROWS))
+            roll = rng.random()
+            if roll < 0.6:
+                # a stall first: the wedge is what turns a burst into
+                # queue depth (an unwedged CPU worker drains a spike
+                # before any deadline can expire)
+                events.append({
+                    "seam": "load", "kind": "slow_tenant", "wave": wave,
+                    "tenant": tenant,
+                    "seconds": round(0.3 + rng.random() * 0.5, 3),
+                })
+            if roll >= 0.3:
+                events.append({
+                    "seam": "load", "kind": "spike", "wave": wave,
+                    "tenant": tenant,
+                    "burst": 6 + rng.randrange(12),
+                })
+        if not events:
+            events.append({
+                "seam": "load", "kind": "spike", "wave": 1,
+                "tenant": rng.randrange(len(FLEET_TENANT_ROWS)),
+                "burst": 8,
+            })
+        return ChaosSchedule(
+            seed=seed, events=tuple(events), run_deadline=30.0,
+        )
+
 
 # -- scenario ----------------------------------------------------------------
 
@@ -487,6 +570,11 @@ class ChaosReport:
     #: evidence: accepted / resolved-exactly-once / orphaned /
     #: multi-resolved counts plus the dropped late resolutions
     fleet: Dict[str, int] = field(default_factory=dict)
+    #: load-seam per-future records (oracle 9/10's evidence): one dict
+    #: per ACCEPTED submission — wave, tenant, SLO class, the worker it
+    #: actually landed on, submit/resolve stamps, and the outcome
+    #: ("ok" | "shed" | "fail:<Type>")
+    load_records: List[dict] = field(default_factory=list)
 
     @property
     def failing(self) -> bool:
@@ -535,6 +623,8 @@ def run_schedule(
     recovery path that silently loses bit-identity — so the oracles (and
     the shrinker on top of them) can be shown to catch a real ladder
     regression."""
+    if any(e.get("seam") == "load" for e in schedule.events):
+        return _run_load_schedule(schedule, simulate_drift=simulate_drift)
     if any(e.get("seam") == "worker" for e in schedule.events):
         return _run_worker_schedule(schedule, simulate_drift=simulate_drift)
     from deequ_tpu.data.source import TableBatchSource
@@ -972,6 +1062,356 @@ def _check_worker_oracles(
     return v
 
 
+# -- the load scenario (overload seam, round 15) -----------------------------
+
+
+def _apply_load_event(fleet, event: dict, submit_flood, applied) -> None:
+    """One scripted load event, while its wave is in flight. ``spike``
+    bursts open-loop flood submissions (no pacing, no gathering until
+    the wave gathers); ``slow_tenant`` wedges the named tenant's PLACED
+    worker briefly — queue pressure, not death (membership is off)."""
+    kind = event["kind"]
+    tenant = int(event["tenant"])
+    if kind == "spike":
+        for i in range(int(event["burst"])):
+            submit_flood(tenant, i)
+        applied.append(("load", "spike", tenant, int(event["burst"])))
+    elif kind == "slow_tenant":
+        seconds = float(event["seconds"])
+        wid = fleet.route_of_tenant(tenant)
+        if wid is not None:
+            # the worker wedges at its NEXT batch take and the wave's
+            # gather rides it out — anything queued behind the wedge
+            # (this wave's traffic, a following spike) waits, and
+            # tight-deadline requests expire in-queue while it sleeps
+            fleet.stall_worker(wid, seconds)
+        applied.append(("load", "slow_tenant", tenant, seconds))
+    else:
+        raise ValueError(f"unknown load event kind {kind!r}")
+
+
+def _run_load_schedule(
+    schedule: ChaosSchedule, simulate_drift: bool = False
+) -> ChaosReport:
+    """The load-seam scenario: the 4-tenant fleet waves with every
+    submission carrying a real SLO class (:data:`LOAD_TENANT_SLO`),
+    the schedule's spikes/stalls applied while their wave is in flight,
+    then oracles 1/2/3/9/10. Admission refusals are TYPED submit-time
+    outcomes (no future minted — counted, not gathered); in-queue
+    deadline sheds are typed resolutions on accepted futures (oracle 9
+    counts them as such)."""
+    from deequ_tpu.exceptions import (
+        DeadlineExceededException,
+        ServiceOverloadedException,
+    )
+    from deequ_tpu.obs.registry import REGISTRY
+    from deequ_tpu.serve.admission import Slo
+    from deequ_tpu.serve.fleet import VerificationFleet
+
+    table = _build_table()
+    tenants = _tenant_slices(table)
+    ref = {t: _fleet_reference(t, tbl) for t, tbl in enumerate(tenants)}
+
+    by_wave: Dict[int, List[dict]] = {}
+    for e in schedule.events:
+        if e.get("seam") == "load":
+            by_wave.setdefault(int(e.get("wave", 0)), []).append(e)
+
+    records: List[dict] = []
+    applied: List[tuple] = []
+    refused = {cls: 0 for cls, _ in set(LOAD_TENANT_SLO)}
+    exc: Optional[BaseException] = None
+    reg_before = REGISTRY.snapshot()
+    t0 = time.monotonic()
+    # membership stays OFF: a scripted stall here is queue pressure the
+    # admission tier must absorb, not a death for failover to mop up
+    fleet = VerificationFleet(
+        n_workers=FLEET_N_WORKERS,
+        heartbeat_interval=FLEET_HEARTBEAT,
+        stall_timeout=FLEET_STALL_TIMEOUT,
+        distinct_devices=False,
+        monitor=False,
+        worker_knobs={
+            "max_pending": LOAD_MAX_PENDING,
+            "coalesce_window": 0.01,
+        },
+    )
+
+    def route_of_tenant(t: int):
+        # the digest must match the SUBMISSIONS' (checks included —
+        # route_digest folds the check's analyzers in), or the stall
+        # wedges a different worker than the tenant's traffic queues on
+        return fleet.route(
+            tenants[t], [_check()], required_analyzers=_analyzers()
+        )
+
+    fleet.route_of_tenant = route_of_tenant
+
+    def submit(wave: int, t: int, cls: str, deadline_ms, tenant_name,
+               kind: str):
+        """One SLO-classed submission; records the ACTUAL worker it
+        landed on (spill included) for the inversion oracle."""
+        try:
+            future = fleet.submit(
+                tenants[t], [_check()],
+                required_analyzers=_analyzers(), tenant=tenant_name,
+                slo=Slo(deadline_ms=deadline_ms, cls=cls),
+            )
+        except ServiceOverloadedException as e:
+            refused[cls] = refused.get(cls, 0) + 1
+            records.append({
+                "wave": wave, "tenant": t, "cls": cls, "kind": kind,
+                "outcome": f"refused:{type(e).__name__}",
+                "worker": None, "future": None,
+            })
+            return
+        with fleet._lock:
+            asg = fleet._assignments.get(future)
+        records.append({
+            "wave": wave, "tenant": t, "cls": cls, "kind": kind,
+            "outcome": None,
+            "worker": asg.worker if asg is not None else None,
+            "future": future,
+        })
+
+    def submit_flood(t: int, i: int):
+        submit(
+            wave, t, "best_effort", LOAD_SPIKE_DEADLINE_MS,
+            f"flood-t{t}-{i}", "spike",
+        )
+
+    try:
+        # warmup wave: no deadlines, standard class — pays the compile
+        # storms so scripted waves measure the admission tier, not XLA
+        warmup = [
+            fleet.submit(
+                tbl, [_check()],
+                required_analyzers=_analyzers(), tenant=f"t{t}",
+                slo=Slo(cls="standard"),
+            )
+            for t, tbl in enumerate(tenants)
+        ]
+        for future in warmup:
+            future.result(timeout=schedule.run_deadline)
+        fleet.prewarm()
+        for wave in range(FLEET_WAVES):
+            wave_start = len(records)
+            wave_events = by_wave.get(wave, ())
+            # slow-tenant stalls apply BEFORE the wave submits: the
+            # worker must already be wedged when traffic arrives, or an
+            # instantaneous burst coalesces into one batch and drains
+            # before the wedge takes effect (real overload is arrival
+            # outpacing a slow server, not a fast server seeing a blip)
+            for e in wave_events:
+                if e["kind"] == "slow_tenant":
+                    _apply_load_event(fleet, e, submit_flood, applied)
+            # a beat for the idle worker to consume the wedge before
+            # the wave queues behind it (deterministic ordering, not a
+            # race: the un-wedged path is also correct, just unloaded)
+            if any(e["kind"] == "slow_tenant" for e in wave_events):
+                time.sleep(0.12)
+            # class-priority submission order (critical first): the
+            # inversion oracle's soundness leans on a critical having
+            # been submitted BEFORE any best_effort it is compared to
+            for t, (cls, deadline_ms) in enumerate(LOAD_TENANT_SLO):
+                submit(wave, t, cls, deadline_ms, f"t{t}", "wave")
+            for e in wave_events:
+                if e["kind"] != "slow_tenant":
+                    _apply_load_event(fleet, e, submit_flood, applied)
+            for rec in records[wave_start:]:
+                if rec["future"] is None:
+                    continue
+                try:
+                    rec["future"].result(timeout=schedule.run_deadline)
+                # deequ-lint: ignore[bare-except] -- the chaos driver observes ANY per-future outcome; oracles 1/9 re-check typedness and exactly-once
+                except Exception:  # noqa: BLE001
+                    pass
+    # deequ-lint: ignore[bare-except] -- a driver-level error becomes the report's outcome; oracle 1 checks it is typed
+    except Exception as e:  # noqa: BLE001
+        exc = e
+    finally:
+        fleet.stop(drain=True)
+    elapsed = time.monotonic() - t0
+    reg_after = REGISTRY.snapshot()
+
+    metrics: Dict[str, tuple] = {}
+    sheds = {cls: 0 for cls, _ in LOAD_TENANT_SLO}
+    for i, rec in enumerate(records):
+        future = rec.pop("future")
+        if future is None:
+            continue  # refused at submit; outcome already recorded
+        rec["submitted_at"] = future.submitted_at
+        rec["resolved_at"] = future.resolved_at
+        rec["resolve_count"] = future.resolve_count
+        if not future.done():
+            rec["outcome"] = "orphaned"
+        elif isinstance(future._error, DeadlineExceededException):
+            rec["outcome"] = "shed"
+            sheds[rec["cls"]] = sheds.get(rec["cls"], 0) + 1
+        elif future._error is not None:
+            rec["outcome"] = f"fail:{type(future._error).__name__}"
+        else:
+            rec["outcome"] = "ok"
+            prefix = f"w{rec['wave']}/{rec['kind']}{i}/t{rec['tenant']}"
+            for name, row in _metric_rows(future._result).items():
+                metrics[f"{prefix}/{name}"] = row
+
+    accepted = [r for r in records if "resolve_count" in r]
+    serve_b = reg_before.get("serve", {})
+    serve_a = reg_after.get("serve", {})
+
+    def serve_delta(key):
+        b, a = serve_b.get(key) or {}, serve_a.get(key) or {}
+        return {cls: a.get(cls, 0) - b.get(cls, 0) for cls in a}
+
+    report = ChaosReport(
+        schedule=schedule,
+        outcome=(
+            f"exception:{type(exc).__name__}" if exc is not None
+            else (
+                "degraded"
+                if any(r["outcome"] != "ok" for r in records)
+                else "identical"
+            )
+        ),
+        elapsed=elapsed,
+        metrics=metrics,
+        injected=applied,
+        load_records=records,
+        fleet={
+            "accepted": len(accepted),
+            "resolved_once": sum(
+                1 for r in accepted
+                if r["outcome"] != "orphaned" and r["resolve_count"] == 1
+            ),
+            "orphaned": sum(
+                1 for r in accepted if r["outcome"] == "orphaned"
+            ),
+            "multi_resolved": sum(
+                1 for r in accepted if r["resolve_count"] > 1
+            ),
+            "shed": sum(sheds.values()),
+            "shed_by_class": sheds,
+            "refused": sum(refused.values()),
+            "shed_counters": serve_delta("shed_by_class"),
+            "admission_rejected_counters": serve_delta(
+                "admission_rejected_by_class"
+            ),
+        },
+    )
+
+    if simulate_drift and applied and report.metrics:
+        report.drifted = True
+        report.metrics = {
+            k: ("ok", v + 1e-9) if status == "ok" else (status, v)
+            for k, (status, v) in report.metrics.items()
+        }
+
+    report.violations = _check_load_oracles(report, ref, exc)
+    return report
+
+
+def _check_load_oracles(
+    report: ChaosReport, ref: Dict[int, Dict[str, tuple]], exc
+) -> List[str]:
+    """The load-seam oracle subset: 1 (typed), 2 (termination), 3
+    (bit-identity of every COMPLETED result), 9 (exactly-once with shed
+    counting as a typed resolution), 10 (no priority inversion)."""
+    from deequ_tpu.exceptions import MetricCalculationException
+
+    v: List[str] = []
+    schedule = report.schedule
+
+    # 1. typed outcome — driver exception, every rejected future, and
+    # every admission refusal must come from the taxonomy
+    if exc is not None and not isinstance(exc, MetricCalculationException):
+        v.append(f"untyped outcome: {type(exc).__name__}: {exc}")
+    for rec in report.load_records:
+        out = rec["outcome"] or ""
+        for tag in ("fail:", "refused:"):
+            if out.startswith(tag):
+                name = out[len(tag):]
+                if not (
+                    name.endswith("Exception") or name.endswith("Error")
+                ):
+                    v.append(
+                        f"load future w{rec['wave']}/t{rec['tenant']}: "
+                        f"suspicious {tag[:-1]} type {name}"
+                    )
+
+    # 2. termination
+    if report.elapsed > schedule.run_deadline * 1.5 + TERMINATION_SLACK:
+        v.append(
+            f"termination: {report.elapsed:.2f}s exceeded "
+            f"run_deadline={schedule.run_deadline:g}s (+slack)"
+        )
+
+    # 9. exactly-once under overload: every ACCEPTED future resolved
+    # exactly once — a shed IS a typed resolution; none orphaned, none
+    # double-resolved
+    fl = report.fleet
+    if fl.get("orphaned"):
+        v.append(
+            f"exactly-once: {fl['orphaned']} of {fl['accepted']} "
+            "accepted futures never resolved under overload"
+        )
+    if fl.get("multi_resolved"):
+        v.append(
+            f"exactly-once: {fl['multi_resolved']} futures applied "
+            "more than one resolution under overload"
+        )
+    if fl.get("resolved_once", 0) != fl.get("accepted", 0) - fl.get(
+        "orphaned", 0
+    ):
+        v.append(f"exactly-once: accounting mismatch ({fl})")
+
+    # 10. no priority inversion: a critical shed on worker w while a
+    # best_effort submitted no earlier DISPATCHED on w before the shed
+    # means the class-tiered queue popped past a waiting critical
+    for c in report.load_records:
+        if c["cls"] != "critical" or c["outcome"] != "shed":
+            continue
+        if c.get("worker") is None or c.get("resolved_at") is None:
+            continue
+        for b in report.load_records:
+            if (
+                b["cls"] == "best_effort"
+                and b["outcome"] == "ok"
+                and b.get("worker") == c["worker"]
+                and b.get("resolved_at") is not None
+                and b["submitted_at"] >= c["submitted_at"]
+                and b["resolved_at"] < c["resolved_at"]
+            ):
+                v.append(
+                    "priority inversion: critical request "
+                    f"(w{c['wave']}/t{c['tenant']}) shed on worker "
+                    f"{c['worker']} while best_effort "
+                    f"(w{b['wave']}/{b['kind']}/t{b['tenant']}) "
+                    "submitted after it dispatched there first"
+                )
+
+    # 3. bit-identity of every COMPLETED result: overload changes WHICH
+    # requests run, never how
+    for key, (status, value) in report.metrics.items():
+        if status != "ok":
+            continue
+        t_part = key.split("/")[2]
+        exp = ref[int(t_part[1:])].get(key.split("/", 3)[3])
+        if exp is None:
+            v.append(f"metric {key}: no reference value")
+        elif exp[0] != "ok":
+            v.append(
+                f"metric {key}: reference failed ({exp[1]}) but the "
+                "overloaded run succeeded"
+            )
+        elif not _bit_identical(value, exp[1]):
+            v.append(
+                f"metric {key}: {value!r} != unloaded serial reference "
+                f"{exp[1]!r} (overload must never degrade computation)"
+            )
+    return v
+
+
 # -- oracles -----------------------------------------------------------------
 
 
@@ -1218,20 +1658,26 @@ def soak(
     simulate_drift: bool = False,
     verbose: bool = True,
     worker: bool = False,
+    load: bool = False,
 ) -> dict:
     """Run ``n`` seeded schedules; returns a summary with every failing
     seed and its shrunk reproducer. The CI entry point
     (``python -m deequ_tpu.resilience.chaos --soak``); ``worker=True``
     (CLI ``--worker``) soaks worker-seam schedules over the fleet
-    scenario instead of the streaming one."""
+    scenario instead of the streaming one; ``load=True`` (CLI
+    ``--load``) soaks load-seam schedules (scripted spikes +
+    slow-tenant stalls under oracles 1/2/3/9/10)."""
     import sys
 
     outcomes: Dict[str, int] = {}
     failures = []
     t0 = time.monotonic()
-    generate = (
-        ChaosSchedule.generate_worker if worker else ChaosSchedule.generate
-    )
+    if load:
+        generate = ChaosSchedule.generate_load
+    elif worker:
+        generate = ChaosSchedule.generate_worker
+    else:
+        generate = ChaosSchedule.generate
     for seed in range(seed0, seed0 + n):
         schedule = generate(seed)
         report = run_schedule(schedule, simulate_drift=simulate_drift)
@@ -1293,6 +1739,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="soak worker-seam schedules (fleet scenario: scripted "
         "worker death/stall/rejoin under oracles 1/2/3/fetch/8)",
     )
+    parser.add_argument(
+        "--load", action="store_true",
+        help="soak load-seam schedules (round 15: scripted open-loop "
+        "spikes + slow-tenant stalls over the SLO-classed fleet "
+        "scenario under oracles 1/2/3/9/10 — exactly-once incl. typed "
+        "sheds, no priority inversion)",
+    )
     args = parser.parse_args(argv)
 
     if args.replay:
@@ -1314,7 +1767,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     n = args.n if args.soak else 20
     summary = soak(
         n=n, seed0=args.seed, simulate_drift=args.drift_sim,
-        worker=args.worker,
+        worker=args.worker, load=args.load,
     )
     print(json.dumps(summary, indent=2, default=str))
     if args.drift_sim:

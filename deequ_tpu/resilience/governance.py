@@ -138,7 +138,8 @@ class RunBudget:
 
     def charge(self, kind: str, **detail) -> None:
         """Spend one attempt of ``kind`` ('io_retry' | 'oom_bisect' |
-        'encoded_demote' | 'mesh_reshard' | 'cpu_fallback' | ...);
+        'encoded_demote' | 'mesh_reshard' | 'cpu_fallback' |
+        'coalesce_retry' | 'worker_failover' | 'deadline_shed' | ...);
         raises typed when this charge exhausts the budget (or it already
         was exhausted)."""
         self.attempts += 1
@@ -294,6 +295,24 @@ def charge_run_budget(kind: str, **detail) -> None:
     budget = current_run_budget()
     if budget is not None:
         budget.charge(kind, **detail)
+
+
+def try_charge(budget: Optional[RunBudget], kind: str, **detail) -> bool:
+    """Charge ``budget`` (None = ungoverned, a no-op) swallowing
+    exhaustion: the serving admission tier's shape — a request being
+    SHED (``kind="deadline_shed"``: its in-queue deadline expired, or a
+    fleet failover found it expired) is already getting a typed terminal
+    outcome, so the charge is ledger bookkeeping, not control flow — an
+    exhausted budget must not replace the shed's
+    ``DeadlineExceededException`` with a budget error. Returns False
+    when the charge exhausted (or found exhausted) the budget."""
+    if budget is None:
+        return True
+    try:
+        budget.charge(kind, **detail)
+        return True
+    except RunBudgetExhaustedException:
+        return False
 
 
 def run_budget_remaining() -> Optional[float]:

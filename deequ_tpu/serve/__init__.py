@@ -32,11 +32,23 @@ plan/executor split another notch):
   a coalescing window, per-tenant run budgets (PR 9 governance; one
   tenant's budget exhaustion never sinks a batch), tenant quarantine
   for repeat offenders, and kill-and-resume of the pending queue.
+- :mod:`admission` — the OVERLOAD tier (round 15): per-tenant
+  :class:`Slo` classes, typed admission control with ``retry_after_s``,
+  a deadline-aware class-tiered tenant-fair queue (expired requests
+  shed typed pre-dispatch), and the 3-level brownout ladder — overload
+  changes WHICH requests run, never how (completed results stay
+  bit-identical to an unloaded serial run).
 
 See docs/serving.md for cache-key semantics, coalescing/padding rules,
 and the isolation ladder.
 """
 
+from deequ_tpu.serve.admission import (
+    AdmissionController,
+    BrownoutController,
+    Slo,
+    TenantFairQueue,
+)
 from deequ_tpu.serve.fleet import FleetConfig, VerificationFleet
 from deequ_tpu.serve.membership import FleetMembership, WorkerLossReport
 from deequ_tpu.serve.plan_cache import PlanCache, PlanKey, ServePlan
@@ -49,6 +61,8 @@ from deequ_tpu.serve.service import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "BrownoutController",
     "ConsistentHashRouter",
     "FleetConfig",
     "FleetMembership",
@@ -58,6 +72,8 @@ __all__ = [
     "route_digest",
     "ServePlan",
     "ServeConfig",
+    "Slo",
+    "TenantFairQueue",
     "VerificationFleet",
     "VerificationFuture",
     "VerificationService",

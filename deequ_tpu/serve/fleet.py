@@ -46,15 +46,19 @@ Chaos seams: :meth:`kill_worker` (scripted death),
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
 from deequ_tpu.exceptions import (
+    DeadlineExceededException,
     RunBudgetExhaustedException,
     ServiceClosedException,
+    ServiceOverloadedException,
     WorkerLostException,
 )
+from deequ_tpu.serve.admission import resolve_slo
 from deequ_tpu.serve.membership import FleetMembership
 from deequ_tpu.serve.router import ConsistentHashRouter, route_digest
 from deequ_tpu.serve.service import (
@@ -180,6 +184,12 @@ class _Assignment:
     digest: str
     worker: int
     failovers: int = 0
+    #: the submission's SLO + its ABSOLUTE deadline, stamped ONCE at
+    #: fleet submit: a failover re-dispatch carries both unchanged, and
+    #: a victim whose deadline already passed is SHED typed on its
+    #: original future instead of replayed stale (round 15)
+    slo: Any = None
+    deadline_at: Optional[float] = None
 
 
 #: the most recent fleet, for the obs registry's read-through section
@@ -387,11 +397,23 @@ class VerificationFleet:
         required_analyzers: Sequence = (),
         tenant=None,
         run_policy=None,
+        slo=None,
     ):
         """Enqueue one suite on its placed worker; returns the future.
         The tenant's budget (``run_policy`` or the fleet default) is
         armed HERE — queue wait, execution, and any failover re-dispatch
-        all draw on the one ledger."""
+        all draw on the one ledger. ``slo`` (serve/admission.Slo) is
+        resolved here too: its absolute deadline stamps ONCE, at fleet
+        acceptance, and follows the request across failover.
+
+        Overload spill: if the placed worker refuses admission typed
+        (``ServiceOverloadedException`` family), the submit walks the
+        ring clockwise (:meth:`ConsistentHashRouter.walk`) and offers
+        the request to each remaining worker once — one hot worker
+        (a flood tenant's home) must not turn away traffic the rest of
+        the fleet has headroom for. Only when EVERY alive worker
+        refuses does the placed worker's typed refusal (carrying its
+        ``retry_after_s``) propagate to the caller."""
         analyzers = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
@@ -401,6 +423,7 @@ class VerificationFleet:
             else self.config.run_policy
         )
         budget = policy.arm() if policy is not None else None
+        slo = resolve_slo(slo)
         with self._failover_lock:
             with self._lock:
                 if self._closed:
@@ -408,19 +431,13 @@ class VerificationFleet:
                         "submit on a stopped VerificationFleet"
                     )
                 self._record_heat(digest)
-                n_candidates = len(self._workers)
             future = None
-            for _ in range(n_candidates + 1):
+            refusal: Optional[ServiceOverloadedException] = None
+            for wid in self._router.walk(digest):
                 with self._lock:
-                    wid = self._router.place(digest)
-                    worker = (
-                        self._workers.get(wid) if wid is not None else None
-                    )
-                if worker is None:
-                    raise ServiceClosedException(
-                        "no alive workers in the fleet (all lost; "
-                        "rejoin_worker or restart)"
-                    )
+                    worker = self._workers.get(wid)
+                if worker is None or not worker.alive:
+                    continue
                 try:
                     future = worker.service.submit(
                         data,
@@ -431,18 +448,28 @@ class VerificationFleet:
                             _PreArmedPolicy(budget)
                             if budget is not None else None
                         ),
+                        slo=slo,
                     )
                     break
+                except ServiceOverloadedException as e:
+                    # typed admission refusal: remember the PLACED
+                    # worker's refusal (its retry_after reflects where
+                    # the tenant's locality lives) and spill clockwise
+                    if refusal is None:
+                        refusal = e
+                    continue
                 except ServiceClosedException:
                     # the placed worker's service died between placement
                     # and enqueue (thread crash not yet declared):
                     # retire it — its ring arcs leave with it — and
-                    # place again on the survivors (reentrant lock)
+                    # keep walking the survivors (reentrant lock)
                     self._handle_loss(wid, WorkerLostException(
                         f"worker {wid} service closed at submit",
                         worker_ids=(wid,),
                     ))
             if future is None:
+                if refusal is not None:
+                    raise refusal
                 raise ServiceClosedException(
                     "no alive workers in the fleet (all lost; "
                     "rejoin_worker or restart)"
@@ -455,6 +482,11 @@ class VerificationFleet:
                 budget=budget,
                 digest=digest,
                 worker=worker.idx,
+                slo=slo,
+                deadline_at=(
+                    future.submitted_at + slo.deadline_seconds
+                    if slo.deadline_seconds is not None else None
+                ),
             )
             with self._lock:
                 self._assignments[future] = asg
@@ -550,8 +582,17 @@ class VerificationFleet:
     def _redispatch(self, future, asg: _Assignment, lost_idx: int,
                     cause: WorkerLostException) -> int:
         """Replay ONE assignment onto a survivor (original future).
-        Charges the tenant's budget first — no free retries — and
+        A victim whose absolute deadline already passed is SHED typed
+        instead (its caller gave up — replaying would resolve stale and
+        burn a survivor's capacity exactly when the fleet is degraded);
+        otherwise charges the tenant's budget — no free retries — and
         rejects typed when retries/survivors run out."""
+        if (
+            asg.deadline_at is not None
+            and time.monotonic() >= asg.deadline_at
+        ):
+            self._shed_expired_victim(future, asg, lost_idx)
+            return 0
         asg.failovers += 1
         if asg.budget is not None:
             try:
@@ -585,6 +626,11 @@ class VerificationFleet:
                 if asg.budget is not None else None
             ),
             future=future,
+            # the ORIGINAL deadline rides along: queue wait accrues
+            # across the failover instead of resetting, so the adopting
+            # worker's fair queue still sheds it if it expires there
+            slo=asg.slo,
+            deadline_at=asg.deadline_at,
         )
         try:
             target.service.resume([req])
@@ -601,6 +647,38 @@ class VerificationFleet:
         asg.worker = target.idx
         self._chain_done(future)  # resume() rebound the observation seam
         return 1
+
+    def _shed_expired_victim(self, future, asg: _Assignment,
+                             lost_idx: int) -> None:
+        """Shed one deadline-expired failover victim typed, exactly
+        once, on its original future (a shed IS a resolution — chaos
+        oracles 8/9 count it), charging the tenant's ledger kind
+        ``deadline_shed`` with exhaustion swallowed (the shed is
+        already the terminal outcome)."""
+        from deequ_tpu.obs.registry import SERVE_SHED_BY_CLASS
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.resilience.governance import try_charge
+
+        cls = asg.slo.cls if asg.slo is not None else "standard"
+        waited = time.monotonic() - future.submitted_at
+        SCAN_STATS.record_degradation(
+            "deadline_shed", tenant=asg.tenant, slo_class=cls,
+            worker=lost_idx, at="failover",
+            waited_s=round(waited, 4),
+        )
+        SERVE_SHED_BY_CLASS[cls].inc()
+        try_charge(
+            asg.budget, "deadline_shed", tenant=asg.tenant,
+            worker=lost_idx,
+        )
+        future._reject(DeadlineExceededException(
+            f"request for tenant {asg.tenant!r} lost worker {lost_idx} "
+            f"after its {cls!r} SLO deadline "
+            f"({asg.slo.deadline_ms:g} ms) already passed — shed at "
+            "failover instead of replayed stale",
+            tenant=asg.tenant, slo_class=cls,
+            deadline_ms=asg.slo.deadline_ms, waited_s=waited,
+        ))
 
     def _finalize_budget_exhausted(self, future, asg: _Assignment,
                                    exhausted: RunBudgetExhaustedException
@@ -694,6 +772,9 @@ class VerificationFleet:
                     "alive": w.alive,
                     "queue_depth": w.queue_depth() if w.alive else 0,
                     "suites_served": w.service.suites_served,
+                    # per-worker ladder level: the global gauge is
+                    # last-writer-wins across workers, this is exact
+                    "brownout_level": w.service._brownout.level,
                 }
                 for i, w in self._workers.items()
             }

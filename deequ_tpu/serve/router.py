@@ -115,6 +115,31 @@ class ConsistentHashRouter:
                 i = 0
             return self._owner[self._points[i]]
 
+    def walk(self, digest: str) -> List[Any]:
+        """Every distinct worker, in clockwise ring order starting at
+        ``digest``'s position — ``walk(d)[0] == place(d)``. The fleet's
+        OVERLOAD SPILL order (round 15): when the placed worker refuses
+        admission typed (queue full, class budget, brownout), spilling
+        to the next worker clockwise trades that request's plan-cache
+        locality for goodput — the same deterministic fallback order a
+        failed-over key would take, so a spilled repeat tenant lands
+        where its plans will migrate anyway. Empty when the ring is."""
+        point = _hash64(digest)
+        with self._lock:
+            if not self._points:
+                return []
+            i = bisect.bisect_right(self._points, point)
+            out: List[Any] = []
+            seen = set()
+            for k in range(len(self._points)):
+                owner = self._owner[
+                    self._points[(i + k) % len(self._points)]
+                ]
+                if owner not in seen:
+                    seen.add(owner)
+                    out.append(owner)
+            return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(set(self._owner.values()))

@@ -40,18 +40,24 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from concurrent.futures import CancelledError
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from deequ_tpu.exceptions import (
+    DeadlineExceededException,
     DeviceException,
     PlanLintError,
     RunBudgetExhaustedException,
     ServiceClosedException,
-    ServiceOverloadedException,
     wrap_if_necessary,
+)
+from deequ_tpu.serve.admission import (
+    SLO_CLASSES,
+    AdmissionController,
+    BrownoutController,
+    TenantFairQueue,
+    resolve_slo,
 )
 
 
@@ -73,6 +79,22 @@ class ServeConfig:
     plan_lint: Optional[str] = None
     quarantine_after: int = 2
     plan_cache_size: int = 256
+    #: default SLO applied to submissions carrying none (None resolves
+    #: the envcfg defaults DEEQU_TPU_SLO_CLASS / _SLO_DEADLINE_MS at
+    #: each submit — see serve/admission.Slo.default)
+    default_slo: Any = None
+    #: brownout ladder switch (None = DEEQU_TPU_BROWNOUT, default on)
+    brownout: Optional[bool] = None
+    #: recent submit->resolve p95 (seconds) above which the ladder
+    #: holds at least level 1 even with a shallow queue — a slow
+    #: backend is overload too (None = queue-depth signal only)
+    brownout_latency_high: Optional[float] = None
+    #: per-tenant queued cap applied at brownout level >= 2 (None =
+    #: max_pending // 16, floor 1 — AdmissionController's default)
+    inflight_cap: Optional[int] = None
+    #: per-class queue-share overrides (merged over
+    #: admission.CLASS_QUEUE_SHARE)
+    class_share: Optional[Dict[str, float]] = None
 
     def __post_init__(self):
         from deequ_tpu.envcfg import env_value
@@ -83,6 +105,8 @@ class ServeConfig:
             self.coalesce_window = env_value(
                 "DEEQU_TPU_SERVE_COALESCE_WINDOW"
             )
+        if self.brownout is None:
+            self.brownout = env_value("DEEQU_TPU_BROWNOUT")
         self.max_batch = int(self.max_batch)
         if self.max_batch < 1:
             raise ValueError(
@@ -233,6 +257,13 @@ class ServeRequest:
     tenant: Any
     run_policy: Any
     future: VerificationFuture
+    #: the submission's SLO (serve/admission.Slo; resolved at submit)
+    #: and its ABSOLUTE monotonic deadline (None = no deadline). The
+    #: deadline is stamped ONCE, at first acceptance — resume() and
+    #: fleet failover re-dispatch carry it unchanged, so queue wait
+    #: accrues across worker recycles instead of resetting
+    slo: Any = None
+    deadline_at: Optional[float] = None
     #: filled at admission: the dedup'd analyzers + the plan fingerprint
     analyzers: Tuple = ()
     key: Any = None
@@ -374,7 +405,22 @@ class VerificationService:
         self._encode = encoded_ingest_enabled(None)
         self._lint_mode = plan_lint_mode(self.config.plan_lint)
         self._cv = threading.Condition()
-        self._pending: deque = deque()
+        # the overload tier (round 15, serve/admission.py): the pending
+        # queue is class-tiered weighted-deficit round-robin across
+        # per-tenant queues with pop-time deadline shedding; admission
+        # gates submit() by class budget + the brownout ladder
+        self._queue = TenantFairQueue()
+        self._brownout = BrownoutController(
+            capacity=self.config.max_pending,
+            latency_high=self.config.brownout_latency_high,
+            enabled=bool(self.config.brownout),
+        )
+        self._admission = AdmissionController(
+            max_pending=self.config.max_pending,
+            brownout=self._brownout,
+            class_share=self.config.class_share,
+            inflight_cap=self.config.inflight_cap,
+        )
         self._running = False
         self._closed = False
         self._idle = True
@@ -414,8 +460,7 @@ class VerificationService:
         with self._cv:
             self._closed = True
             self._running = False
-            pending = list(self._pending)
-            self._pending.clear()
+            pending = self._queue.drain()
             self._cv.notify_all()
         if join and self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=30.0)
@@ -439,7 +484,7 @@ class VerificationService:
                 # observe into THIS service's recorder, not the stopped
                 # donor's
                 req.future._on_done = self._observe_done
-                self._pending.append(req)
+                self._queue.push(req)
             self._cv.notify_all()
 
     def inject_stall(self, seconds: float) -> None:
@@ -477,8 +522,8 @@ class VerificationService:
         """Block until the queue is empty and the worker is idle."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
-            while self._pending or not self._idle:
-                if not self._running and self._pending:
+            while len(self._queue) or not self._idle:
+                if not self._running and len(self._queue):
                     raise ServiceClosedException(
                         "service stopped with requests pending"
                     )
@@ -504,14 +549,23 @@ class VerificationService:
         required_analyzers: Sequence = (),
         tenant=None,
         run_policy=None,
+        slo=None,
     ) -> VerificationFuture:
         """Enqueue one verification suite; returns its future. The
         suite's fault budget is ``run_policy`` (or the service default);
-        backpressure is typed — a full queue raises
-        ``ServiceOverloadedException`` instead of buffering without
-        bound."""
-        from deequ_tpu.obs.registry import SERVE_SUBMITTED
+        ``slo`` (serve/admission.Slo) sets its class, fair-share weight,
+        and absolute deadline (default: the service/envcfg default).
+        Backpressure is typed and structured — a full queue raises
+        ``ServiceOverloadedException``; a class over its queue budget or
+        a class the brownout ladder is shedding raises
+        ``AdmissionRejectedException`` — both carrying ``queue_depth`` /
+        ``retry_after_s`` / ``slo_class`` so callers can schedule a
+        retry instead of hammering."""
+        from deequ_tpu.obs.registry import SERVE_QUEUE_DEPTH, SERVE_SUBMITTED
 
+        slo = resolve_slo(
+            slo if slo is not None else self.config.default_slo
+        )
         future = VerificationFuture(tenant)
         future._on_done = self._observe_done
         req = ServeRequest(
@@ -524,6 +578,11 @@ class VerificationService:
                 else self.config.run_policy
             ),
             future=future,
+            slo=slo,
+            deadline_at=(
+                future.submitted_at + slo.deadline_seconds
+                if slo.deadline_seconds is not None else None
+            ),
         )
         with self._cv:
             # a not-yet-started service accepts work (it queues until
@@ -532,21 +591,29 @@ class VerificationService:
                 raise ServiceClosedException(
                     "submit on a stopped VerificationService"
                 )
-            if len(self._pending) >= self.config.max_pending:
-                raise ServiceOverloadedException(
-                    f"{len(self._pending)} requests pending >= "
-                    f"max_pending={self.config.max_pending}"
-                )
-            self._pending.append(req)
+            depth = len(self._queue)
+            # publish the depth the admission decision reads — the
+            # registry gauge IS the brownout ladder's queue-depth feed
+            SERVE_QUEUE_DEPTH.set(depth)
+            self._admission.admit(
+                tenant=tenant,
+                slo=slo,
+                queue_depth=depth,
+                class_depth=self._queue.class_depth(slo.cls),
+                tenant_pending=self._queue.tenant_depth(tenant),
+            )
+            self._queue.push(req)
             # accounting AFTER the enqueue succeeded but BEFORE the
             # worker is notified: SERVE_SUBMITTED means "accepted" (a
-            # typed closed/overload refusal above must not count), and
-            # incrementing outside the lock would let a fast worker
-            # resolve the request first — a concurrent scrape would see
-            # resolved > submitted
+            # typed closed/overload/admission refusal above must not
+            # count), and incrementing outside the lock would let a
+            # fast worker resolve the request first — a concurrent
+            # scrape would see resolved > submitted
             SERVE_SUBMITTED.inc()
             if self._recorder is not None:
-                self._recorder.event("serve_submit", tenant=str(tenant))
+                self._recorder.event(
+                    "serve_submit", tenant=str(tenant), slo_class=slo.cls,
+                )
             self._cv.notify_all()
         return future
 
@@ -585,6 +652,10 @@ class VerificationService:
             return
         tenant = "?" if future.tenant is None else str(future.tenant)
         SERVE_LATENCY.observe(tenant, latency)
+        # the same value the registry histogram observes feeds the
+        # brownout ladder's latency signal (consulted only when
+        # ServeConfig.brownout_latency_high arms it)
+        self._brownout.observe_latency(latency)
         if self._recorder is not None:
             self._recorder.record_span(
                 "serve_request",
@@ -647,11 +718,17 @@ class VerificationService:
                         self._cv.notify_all()
 
     def _take_batch(self) -> Optional[List[ServeRequest]]:
-        """Pop up to ``max_batch`` requests, waiting ``coalesce_window``
-        after the first arrival for co-batchable company."""
+        """Pop up to ``max_batch`` requests — class priority then
+        weighted tenant fair share (TenantFairQueue) — waiting
+        ``coalesce_window`` after the first arrival for co-batchable
+        company. Requests whose absolute deadline expired in-queue are
+        SHED here, pre-dispatch: collected under the lock, resolved
+        typed after releasing it (a future's resolution callback may
+        take foreign locks — the fleet ledger's — and must never nest
+        inside ``_cv``)."""
         cfg = self.config
         with self._cv:
-            while not self._pending:
+            while not len(self._queue):
                 if not self._running:
                     return None
                 if self._stall_seconds:
@@ -663,25 +740,75 @@ class VerificationService:
                     return []
                 self._idle = True
                 self.heartbeat = time.monotonic()
+                # idle ticks walk the brownout ladder back down: the
+                # pre-pop update below last saw the FULL backlog, so a
+                # queue drained in one wide batch would otherwise park
+                # the service at a high level and refuse the first
+                # best_effort submissions against an empty queue
+                self._brownout.update(0)
                 self._cv.notify_all()
                 self._cv.wait(0.1)
             self._idle = False
         if cfg.coalesce_window > 0 and cfg.max_batch > 1:
             deadline = time.monotonic() + cfg.coalesce_window
             with self._cv:
-                while len(self._pending) < cfg.max_batch:
+                while len(self._queue) < cfg.max_batch:
                     left = deadline - time.monotonic()
                     if left <= 0 or not self._running:
                         break
                     self._cv.wait(left)
         out: List[ServeRequest] = []
+        shed: List[ServeRequest] = []
         with self._cv:
             from deequ_tpu.obs.registry import SERVE_QUEUE_DEPTH
 
-            SERVE_QUEUE_DEPTH.set(len(self._pending))
-            while self._pending and len(out) < cfg.max_batch:
-                out.append(self._pending.popleft())
+            SERVE_QUEUE_DEPTH.set(len(self._queue))
+            # drain-side ladder update: levels come back DOWN while the
+            # worker empties the queue even if nobody submits
+            self._brownout.update(len(self._queue))
+            now = time.monotonic()
+            while len(self._queue) and len(out) < cfg.max_batch:
+                req = self._queue.pop(now, shed.append)
+                if req is None:
+                    break
+                out.append(req)
+            # post-pop update: this batch may have taken the whole
+            # backlog, and the level should reflect what REMAINS
+            self._brownout.update(len(self._queue))
+        for req in shed:
+            self._shed_expired(req)
         return out
+
+    def _shed_expired(self, req: ServeRequest) -> None:
+        """Resolve one deadline-expired request typed, exactly once, on
+        its original future (a shed IS a resolution — chaos oracle 9
+        counts it), charging the tenant's budget kind ``deadline_shed``
+        (exhaustion swallowed: the shed is already the terminal
+        outcome). Called OUTSIDE the queue lock."""
+        from deequ_tpu.obs.registry import SERVE_SHED_BY_CLASS
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.resilience.governance import try_charge
+
+        waited = time.monotonic() - req.future.submitted_at
+        SCAN_STATS.record_degradation(
+            "deadline_shed", tenant=req.tenant, slo_class=req.slo.cls,
+            deadline_ms=req.slo.deadline_ms, waited_s=round(waited, 4),
+        )
+        SERVE_SHED_BY_CLASS[req.slo.cls].inc()
+        budget = (
+            req.run_policy.arm() if req.run_policy is not None else None
+        )
+        try_charge(budget, "deadline_shed", tenant=req.tenant)
+        # NOT a tenant failure for quarantine accounting: the tenant's
+        # data never ran, so health stays untouched either way
+        req.future._reject(DeadlineExceededException(
+            f"request for tenant {req.tenant!r} expired in-queue: waited "
+            f"{waited * 1000:.1f} ms past its {req.slo.cls!r} SLO "
+            f"deadline of {req.slo.deadline_ms:g} ms — shed pre-dispatch",
+            tenant=req.tenant, slo_class=req.slo.cls,
+            deadline_ms=req.slo.deadline_ms, waited_s=waited,
+            retry_after_s=self._admission.retry_after(self.pending_count()),
+        ))
 
     # -- execution -------------------------------------------------------
 
@@ -692,6 +819,7 @@ class VerificationService:
                 alive.append(req)
         if not alive:
             return
+        batch_t0 = time.monotonic()
         groups: Dict[Any, List[ServeRequest]] = {}
         serial: List[ServeRequest] = []
         for req in alive:
@@ -727,6 +855,11 @@ class VerificationService:
                     req.future._reject(wrap_if_necessary(e))
         self.batches_served += 1
         self.suites_served += len(alive)
+        # the drain-rate feed behind retry_after: refused callers are
+        # told when the queue will plausibly have drained at this rate
+        self._admission.note_served(
+            len(alive), time.monotonic() - batch_t0
+        )
 
     def _admit(self, req: ServeRequest) -> None:
         """Fingerprint the request and decide coalescability (schema +
@@ -1056,13 +1189,20 @@ class VerificationService:
 
     def pending_count(self) -> int:
         with self._cv:
-            return len(self._pending)
+            return len(self._queue)
 
     def stats(self) -> dict:
+        with self._cv:
+            pending = len(self._queue)
+            by_class = {
+                cls: self._queue.class_depth(cls) for cls in SLO_CLASSES
+            }
         return {
             "batches_served": self.batches_served,
             "suites_served": self.suites_served,
-            "pending": self.pending_count(),
+            "pending": pending,
+            "pending_by_class": by_class,
+            "brownout_level": self._brownout.level,
             "plan_cache_entries": len(self.plan_cache),
             "quarantined_tenants": sorted(
                 map(str, self.tenant_health.quarantined)

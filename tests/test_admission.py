@@ -1,0 +1,584 @@
+"""Overload-tier suite (deequ_tpu/serve/admission.py, round 15) —
+tier-1 `slo`.
+
+Contracts pinned here:
+
+- SLO surface: ``Slo`` validation, envcfg-registered defaults
+  (DEEQU_TPU_SLO_CLASS / DEEQU_TPU_SLO_DEADLINE_MS / DEEQU_TPU_BROWNOUT
+  — typed ``EnvConfigError`` on garbage), and the structured
+  ``ServiceOverloadedException`` family (``queue_depth`` /
+  ``retry_after_s`` / ``slo_class``; admission + deadline exceptions
+  subclass it);
+- admission control: accept / typed reject with a drain-rate-derived
+  ``retry_after_s``, per-class queue budgets (reserved critical
+  headroom), and the brownout ladder's admission policy (level 1 sheds
+  best_effort, level 2 caps per-tenant inflight, level 3 admits
+  critical only);
+- the deadline-aware tenant-fair queue: strict class priority (the
+  structural no-priority-inversion guarantee), weighted deficit
+  round-robin under a flood tenant, pop-time deadline shedding resolved
+  EXACTLY ONCE typed on the original future, and kill-and-resume
+  carrying the ORIGINAL absolute deadline;
+- the brownout ladder: hysteretic transitions up AND down, never
+  degrading computation — every completed result under overload is
+  bit-identical to its unloaded serial run;
+- the chaos ``load`` seam: the shrunk fixture corpus replays with zero
+  oracle violations (exactly-once incl. typed sheds, no priority
+  inversion).
+"""
+
+import glob
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from deequ_tpu import VerificationSuite
+from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.exceptions import (
+    AdmissionRejectedException,
+    DeadlineExceededException,
+    EnvConfigError,
+    ServeException,
+    ServiceOverloadedException,
+)
+from deequ_tpu.parallel.mesh import use_mesh
+from deequ_tpu.serve import VerificationService
+from deequ_tpu.serve.admission import (
+    CLASS_QUEUE_SHARE,
+    SLO_CLASSES,
+    AdmissionController,
+    BrownoutController,
+    Slo,
+    TenantFairQueue,
+    resolve_slo,
+)
+
+pytestmark = pytest.mark.slo
+
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(__file__), "fixtures", "chaos", "load"
+)
+
+
+def _table(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    return ColumnarTable([
+        Column("x", DType.FRACTIONAL, values=r.normal(100, 5, n),
+               mask=r.random(n) > 0.05),
+        Column("i", DType.INTEGRAL,
+               values=r.integers(0, 50, n).astype(np.float64),
+               mask=np.ones(n, bool)),
+    ])
+
+
+def _analyzers():
+    return [Size(), Completeness("x"), Mean("x"), Sum("i")]
+
+
+def _bits(v):
+    return struct.pack("<d", v) if isinstance(v, float) else v
+
+
+@pytest.fixture
+def single_device():
+    with use_mesh(None):
+        yield
+
+
+# -- the SLO surface ---------------------------------------------------------
+
+
+def test_slo_validation_and_resolution():
+    assert Slo().cls == "standard" and Slo().deadline_ms is None
+    assert Slo(deadline_ms=250.0).deadline_seconds == 0.25
+    assert Slo(cls="critical").deadline_seconds is None
+    with pytest.raises(ValueError, match="cls"):
+        Slo(cls="urgent")
+    with pytest.raises(ValueError, match="deadline_ms"):
+        Slo(deadline_ms=0.0)
+    with pytest.raises(ValueError, match="weight"):
+        Slo(weight=0.0)
+    with pytest.raises(TypeError):
+        resolve_slo("critical")
+    explicit = Slo(cls="best_effort")
+    assert resolve_slo(explicit) is explicit
+
+
+def test_slo_env_defaults(monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_SLO_CLASS", "critical")
+    monkeypatch.setenv("DEEQU_TPU_SLO_DEADLINE_MS", "250")
+    slo = resolve_slo(None)
+    assert slo.cls == "critical" and slo.deadline_ms == 250.0
+    monkeypatch.setenv("DEEQU_TPU_SLO_DEADLINE_MS", "0")  # 0 disables
+    assert resolve_slo(None).deadline_ms is None
+    monkeypatch.setenv("DEEQU_TPU_SLO_CLASS", "urgent")
+    with pytest.raises(EnvConfigError, match="DEEQU_TPU_SLO_CLASS"):
+        resolve_slo(None)
+    monkeypatch.setenv("DEEQU_TPU_SLO_CLASS", "standard")
+    monkeypatch.setenv("DEEQU_TPU_SLO_DEADLINE_MS", "banana")
+    with pytest.raises(EnvConfigError, match="DEEQU_TPU_SLO_DEADLINE_MS"):
+        resolve_slo(None)
+
+
+def test_overload_exception_family_structured():
+    base = ServiceOverloadedException(
+        "full", queue_depth=7, retry_after_s=0.5, slo_class="standard"
+    )
+    assert isinstance(base, ServeException)
+    assert (base.queue_depth, base.retry_after_s, base.slo_class) == (
+        7, 0.5, "standard"
+    )
+    rej = AdmissionRejectedException(
+        "budget", reason="class_budget", queue_depth=3,
+        retry_after_s=0.1, slo_class="best_effort",
+    )
+    assert isinstance(rej, ServiceOverloadedException)
+    assert rej.reason == "class_budget"
+    shed = DeadlineExceededException(
+        "late", tenant="t0", slo_class="best_effort",
+        deadline_ms=100.0, waited_s=0.2,
+    )
+    assert isinstance(shed, ServiceOverloadedException)
+    assert shed.tenant == "t0" and shed.waited_s == 0.2
+    # pre-round-15 raise sites carried a message only: fields optional
+    assert ServiceOverloadedException("legacy").queue_depth is None
+
+
+# -- admission controller ----------------------------------------------------
+
+
+def _admit(ctrl, cls="standard", depth=0, class_depth=0, tenant_pending=0,
+           tenant="t"):
+    return ctrl.admit(
+        tenant=tenant, slo=Slo(cls=cls), queue_depth=depth,
+        class_depth=class_depth, tenant_pending=tenant_pending,
+    )
+
+
+def test_admission_accept_reject_and_retry_after():
+    ctrl = AdmissionController(max_pending=10, brownout=None)
+    assert _admit(ctrl, depth=0) == 0  # accepted, no brownout
+    with pytest.raises(ServiceOverloadedException) as e:
+        _admit(ctrl, depth=10)
+    assert e.value.queue_depth == 10
+    assert e.value.retry_after_s > 0
+    assert e.value.slo_class == "standard"
+    # the drain-rate feed turns refusals into a schedule: 10 served in
+    # 1s -> a 19-deep queue drains in ~2s
+    ctrl.note_served(10, 1.0)
+    assert 1.0 < ctrl.retry_after(19) < 4.0
+    assert ctrl.retry_after(10 ** 9) == 30.0  # bounded
+
+
+def test_admission_class_queue_budgets_reserve_critical_headroom():
+    ctrl = AdmissionController(max_pending=10, brownout=None)
+    # best_effort owns half the queue: refused at class_depth 5 even
+    # though the queue itself has room
+    with pytest.raises(AdmissionRejectedException) as e:
+        _admit(ctrl, cls="best_effort", depth=5, class_depth=5)
+    assert e.value.reason == "class_budget"
+    # critical may use the whole queue
+    assert CLASS_QUEUE_SHARE["critical"] == 1.0
+    _admit(ctrl, cls="critical", depth=9, class_depth=9)
+    with pytest.raises(ValueError):
+        AdmissionController(max_pending=10, class_share={"vip": 0.5})
+
+
+def test_admission_brownout_policy_by_level():
+    # capacity 10: depth 5 -> level 1, 8 -> level 2, 9 -> level 3
+    ctrl = AdmissionController(
+        max_pending=10, brownout=BrownoutController(capacity=10),
+        inflight_cap=2,
+    )
+    # level 1: best_effort admissions shed, standard still admitted
+    with pytest.raises(AdmissionRejectedException) as e:
+        _admit(ctrl, cls="best_effort", depth=5, class_depth=1)
+    assert e.value.reason == "brownout_best_effort"
+    assert _admit(ctrl, cls="standard", depth=5, class_depth=1) == 1
+    # level 2: per-tenant inflight cap on top
+    with pytest.raises(AdmissionRejectedException) as e:
+        _admit(ctrl, cls="standard", depth=8, class_depth=1,
+               tenant_pending=2)
+    assert e.value.reason == "tenant_inflight_cap"
+    # level 3: critical only
+    with pytest.raises(AdmissionRejectedException) as e:
+        _admit(ctrl, cls="standard", depth=9, class_depth=1)
+    assert e.value.reason == "brownout_critical_only"
+    assert _admit(ctrl, cls="critical", depth=9, class_depth=1) == 3
+
+
+# -- brownout ladder ---------------------------------------------------------
+
+
+def test_brownout_transitions_up_and_down_hysteretic():
+    b = BrownoutController(capacity=100)
+    assert b.update(10) == 0
+    # ascent jumps straight to the highest threshold crossed
+    assert b.update(95) == 3
+    # descent is one level per update, and only below the DOWN bar
+    assert b.update(95) == 3
+    assert b.update(65) == 2   # 0.65 < down[2]=0.7
+    assert b.update(65) == 2   # 0.65 >= down[1]=0.5: holds
+    assert b.update(45) == 1
+    assert b.update(20) == 0
+    assert b.transitions == 4
+    # disabled ladder never leaves 0
+    off = BrownoutController(capacity=100, enabled=False)
+    assert off.update(100) == 0
+
+
+def test_brownout_threshold_validation_and_latency_signal():
+    with pytest.raises(ValueError, match="hysteresis"):
+        BrownoutController(capacity=10, up=(0.5, 0.7, 0.9),
+                           down=(0.5, 0.5, 0.7))
+    with pytest.raises(ValueError, match="ascend"):
+        BrownoutController(capacity=10, up=(0.9, 0.7, 0.5),
+                           down=(0.2, 0.3, 0.4))
+    # a slow backend is overload too: hot p95 holds level >= 1 with a
+    # shallow queue
+    b = BrownoutController(capacity=100, latency_high=0.1)
+    for _ in range(20):
+        b.observe_latency(0.5)
+    assert b.update(0) == 1
+    assert b.update(0) == 1  # latency still hot: no descent
+    b._lat.clear()
+    for _ in range(20):
+        b.observe_latency(0.001)
+    assert b.update(0) == 0
+
+
+# -- the deadline-aware tenant-fair queue ------------------------------------
+
+
+class _Req:
+    def __init__(self, tenant, cls="standard", weight=1.0,
+                 deadline_at=None):
+        self.tenant = tenant
+        self.slo = Slo(cls=cls, weight=weight)
+        self.deadline_at = deadline_at
+
+
+def test_queue_strict_class_priority():
+    q = TenantFairQueue()
+    q.push(_Req("flood", cls="best_effort"))
+    q.push(_Req("s", cls="standard"))
+    q.push(_Req("c", cls="critical"))
+    order = [q.pop(0.0, lambda r: None).tenant for _ in range(3)]
+    assert order == ["c", "s", "flood"]
+    assert q.pop(0.0, lambda r: None) is None
+
+
+def test_queue_wdrr_fairness_under_flood_tenant():
+    q = TenantFairQueue()
+    for _ in range(50):
+        q.push(_Req("flood"))
+    q.push(_Req("victim-a"))
+    q.push(_Req("victim-b", weight=2.0))
+    first = [q.pop(0.0, lambda r: None).tenant for _ in range(6)]
+    # one rotation grants every tenant a slot: both victims dispatch
+    # within the first handful of pops instead of behind 50 floods
+    assert "victim-a" in first and "victim-b" in first
+    # weights scale the share inside a class: a weight-2 tenant drains
+    # 2x the slots of a weight-1 tenant under the same contention
+    q = TenantFairQueue()
+    for _ in range(40):
+        q.push(_Req("flood"))
+        q.push(_Req("heavy", weight=2.0))
+    window = [q.pop(0.0, lambda r: None).tenant for _ in range(30)]
+    assert window.count("heavy") >= 2 * window.count("flood") - 2
+
+
+def test_queue_pop_time_deadline_shed():
+    q = TenantFairQueue()
+    q.push(_Req("late", deadline_at=10.0))
+    q.push(_Req("late", deadline_at=11.0))
+    q.push(_Req("alive", deadline_at=99.0))
+    shed = []
+    got = q.pop(50.0, shed.append)
+    assert got.tenant == "alive"
+    assert [r.tenant for r in shed] == ["late", "late"]
+    assert len(q) == 0
+    assert q.class_depth("standard") == 0
+
+
+def test_queue_depths_and_drain():
+    q = TenantFairQueue()
+    q.push(_Req("a", cls="critical"))
+    q.push(_Req("a"))
+    q.push(_Req("b", cls="best_effort"))
+    assert len(q) == 3
+    assert q.tenant_depth("a") == 2
+    assert q.class_depth("critical") == 1
+    assert q.depths()["best_effort"] == {"b": 1}
+    drained = q.drain()
+    assert [r.tenant for r in drained] == ["a", "a", "b"]
+    assert len(q) == 0
+
+
+# -- service-level integration -----------------------------------------------
+
+
+def test_service_deadline_shed_exactly_once_typed(single_device):
+    from deequ_tpu.obs.registry import SERVE_SHED_BY_CLASS
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    before = SERVE_SHED_BY_CLASS["best_effort"].value
+    svc = VerificationService(start=False, coalesce_window=0.0)
+    try:
+        doomed = svc.submit(
+            _table(seed=1), required_analyzers=_analyzers(), tenant="late",
+            slo=Slo(deadline_ms=10.0, cls="best_effort"),
+        )
+        ok = svc.submit(
+            _table(seed=2), required_analyzers=_analyzers(), tenant="ok",
+        )
+        time.sleep(0.05)  # the queued deadline expires before start()
+        svc.start()
+        with pytest.raises(DeadlineExceededException) as e:
+            doomed.result(timeout=60)
+        assert e.value.slo_class == "best_effort"
+        assert e.value.tenant == "late"
+        assert e.value.waited_s > 0
+        assert e.value.retry_after_s is not None
+        assert doomed.resolve_count == 1
+        assert SERVE_SHED_BY_CLASS["best_effort"].value == before + 1
+        assert any(
+            d.get("kind") == "deadline_shed"
+            for d in SCAN_STATS.degradation_events
+        )
+        # the shed is not a tenant failure: the healthy submission
+        # completes and the tenant is not quarantined
+        assert all(
+            m.value.is_success for m in ok.result(timeout=60).metrics.values()
+        )
+        assert not svc.tenant_health.is_quarantined("late")
+    finally:
+        svc.stop(drain=False)
+
+
+def test_service_flood_tenant_cannot_starve_victim(single_device):
+    svc = VerificationService(start=False, max_batch=4, coalesce_window=0.0)
+    try:
+        flood = [
+            svc.submit(
+                _table(seed=3), required_analyzers=_analyzers(),
+                tenant="flood",
+            )
+            for _ in range(16)
+        ]
+        victim = svc.submit(
+            _table(seed=4), required_analyzers=_analyzers(), tenant="victim",
+        )
+        svc.start()
+        victim.result(timeout=60)
+        for f in flood:
+            f.result(timeout=60)
+        # WDRR: the victim rides an early batch, not behind the flood
+        assert victim.resolved_at <= max(f.resolved_at for f in flood)
+        slowest = sorted(f.resolved_at for f in flood)
+        assert victim.resolved_at < slowest[-2]
+    finally:
+        svc.stop(drain=False)
+
+
+def test_service_brownout_ladder_up_then_down(single_device):
+    svc = VerificationService(
+        start=False, max_pending=10, max_batch=4, coalesce_window=0.0,
+    )
+    try:
+        futures = [
+            svc.submit(
+                _table(seed=5), required_analyzers=_analyzers(),
+                tenant=f"t{i}",
+            )
+            for i in range(6)
+        ]
+        # depth crossed 0.5x capacity at the last admit: level >= 1,
+        # and best_effort admissions shed typed
+        assert svc._brownout.level >= 1
+        with pytest.raises(AdmissionRejectedException) as e:
+            svc.submit(
+                _table(seed=6), required_analyzers=_analyzers(),
+                tenant="be", slo=Slo(cls="best_effort"),
+            )
+        assert e.value.reason == "brownout_best_effort"
+        assert e.value.retry_after_s is not None
+        svc.start()
+        for f in futures:
+            f.result(timeout=60)
+        svc.flush(timeout=60)
+        # the drain-side ladder steps back down as the queue empties
+        assert svc._brownout.level == 0
+        ok = svc.submit(
+            _table(seed=7), required_analyzers=_analyzers(),
+            tenant="be", slo=Slo(cls="best_effort"),
+        )
+        assert all(
+            m.value.is_success for m in ok.result(timeout=60).metrics.values()
+        )
+        assert svc._brownout.transitions >= 2
+    finally:
+        svc.stop(drain=False)
+
+
+def test_service_brownout_descends_after_one_batch_drain(single_device):
+    """A backlog drained in ONE wide batch must not park the service at
+    a high brownout level: idle worker ticks walk the ladder back down,
+    so a quiet service never refuses best_effort against an empty
+    queue."""
+    svc = VerificationService(
+        start=False, max_pending=10, max_batch=32, coalesce_window=0.0,
+    )
+    try:
+        futures = [
+            svc.submit(
+                _table(seed=20), required_analyzers=_analyzers(),
+                tenant=f"t{i}",
+                # critical: may fill the whole queue (class share 1.0)
+                slo=Slo(cls="critical"),
+            )
+            for i in range(9)  # depth 8 at the last admit: level 2
+        ]
+        assert svc._brownout.level >= 2
+        svc.start()
+        for f in futures:
+            f.result(timeout=60)
+        svc.flush(timeout=60)
+        deadline = time.monotonic() + 5.0
+        while svc._brownout.level and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc._brownout.level == 0
+        ok = svc.submit(
+            _table(seed=21), required_analyzers=_analyzers(),
+            tenant="be", slo=Slo(cls="best_effort"),
+        )
+        assert all(
+            m.value.is_success for m in ok.result(timeout=60).metrics.values()
+        )
+    finally:
+        svc.stop(drain=False)
+
+
+def test_completed_results_bit_identical_under_overload(single_device):
+    table = _table(n=128, seed=8)
+    serial = VerificationSuite.run(table, [], required_analyzers=_analyzers())
+    svc = VerificationService(start=False, max_batch=8, coalesce_window=0.0)
+    try:
+        doomed = [
+            svc.submit(
+                table, required_analyzers=_analyzers(), tenant="late",
+                slo=Slo(deadline_ms=5.0, cls="best_effort"),
+            )
+            for _ in range(4)
+        ]
+        alive = [
+            svc.submit(
+                table, required_analyzers=_analyzers(), tenant=f"t{i}",
+                slo=Slo(cls="critical" if i % 2 else "standard"),
+            )
+            for i in range(6)
+        ]
+        time.sleep(0.05)
+        svc.start()
+        shed = 0
+        for f in doomed:
+            try:
+                f.result(timeout=60)
+            except DeadlineExceededException:
+                shed += 1
+        assert shed == 4
+        for f in alive:
+            result = f.result(timeout=60)
+            for a, m1 in serial.metrics.items():
+                m2 = result.metrics[a]
+                assert m1.value.is_success and m2.value.is_success
+                assert _bits(m1.value.get()) == _bits(m2.value.get()), (
+                    "overload must never degrade computation: "
+                    f"{a} {m2.value.get()!r} != serial {m1.value.get()!r}"
+                )
+    finally:
+        svc.stop(drain=False)
+
+
+def test_kill_and_resume_preserves_original_deadline(single_device):
+    donor = VerificationService(start=False)
+    req_deadline = None
+    try:
+        future = donor.submit(
+            _table(seed=9), required_analyzers=_analyzers(), tenant="move",
+            slo=Slo(deadline_ms=40.0, cls="standard"),
+        )
+        pending = donor.stop(drain=False)
+        assert len(pending) == 1
+        req_deadline = pending[0].deadline_at
+        assert req_deadline is not None
+        # queue wait accrues ACROSS the recycle: by adoption time the
+        # original absolute deadline has passed, so the adopting
+        # service sheds instead of serving stale
+        time.sleep(0.06)
+        adopter = VerificationService(start=True, coalesce_window=0.0)
+        try:
+            adopter.resume(pending)
+            assert pending[0].deadline_at == req_deadline
+            with pytest.raises(DeadlineExceededException):
+                future.result(timeout=60)
+            assert future.resolve_count == 1
+        finally:
+            adopter.stop(drain=False)
+    finally:
+        donor.stop(drain=False)
+
+
+def test_stats_and_admission_counters(single_device):
+    from deequ_tpu.obs.registry import REGISTRY, SERVE_ADMITTED_BY_CLASS
+
+    before = SERVE_ADMITTED_BY_CLASS["critical"].value
+    svc = VerificationService(start=False)
+    try:
+        svc.submit(
+            _table(seed=10), required_analyzers=_analyzers(), tenant="a",
+            slo=Slo(cls="critical"),
+        )
+        assert SERVE_ADMITTED_BY_CLASS["critical"].value == before + 1
+        stats = svc.stats()
+        assert stats["pending"] == 1
+        assert stats["pending_by_class"]["critical"] == 1
+        assert stats["brownout_level"] == 0
+        section = REGISTRY.snapshot()["serve"]
+        assert section["admitted_by_class"]["critical"] >= before + 1
+        assert set(section["shed_by_class"]) == set(SLO_CLASSES)
+        assert "brownout_level" in section
+    finally:
+        svc.stop(drain=False)
+
+
+# -- chaos load fixtures -----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.json"))),
+    ids=lambda p: os.path.basename(p).replace(".json", ""),
+)
+def test_chaos_load_fixture_replays_clean(fixture):
+    """The shrunk ``load``-seam corpus: every replay holds oracles
+    1/2/3/9/10 — exactly-once (a typed shed IS a resolution), no
+    priority inversion, bit-identical completions. Outcomes (which
+    requests shed) are load-dependent and may vary run to run; the
+    ORACLES may not."""
+    from deequ_tpu.resilience.chaos import ChaosSchedule, run_schedule
+
+    with open(fixture) as f:
+        schedule = ChaosSchedule.from_json(f.read())
+    report = run_schedule(schedule)
+    assert report.violations == [], report.violations
+    fl = report.fleet
+    assert fl["accepted"] > 0
+    assert fl["orphaned"] == 0 and fl["multi_resolved"] == 0
+    assert fl["resolved_once"] == fl["accepted"]
+    # the per-class ledger: nothing critical ever sheds in the corpus
+    assert fl["shed_by_class"].get("critical", 0) == 0

@@ -674,3 +674,110 @@ def test_fleet_concurrent_submitters_one_resolution_each():
             assert f.resolve_count == 1, t
     finally:
         fleet.stop(drain=True)
+
+
+# -- round-15 overload tier at the fleet seam --------------------------------
+
+
+def test_fleet_failover_sheds_expired_victim_typed():
+    """The round-15 deadline fix: a re-dispatched victim whose absolute
+    SLO deadline already passed is SHED typed on its original future —
+    not replayed to resolve stale — exactly once, without counting as a
+    re-dispatch."""
+    from deequ_tpu.exceptions import DeadlineExceededException
+    from deequ_tpu.serve import Slo
+
+    table = _table(seed=31)
+    fleet = _fleet(n_workers=2)
+    try:
+        wid = fleet.route(table, required_analyzers=_analyzers())
+        fleet.stall_worker(wid, seconds=30.0)
+        time.sleep(0.05)
+        doomed = fleet.submit(
+            table, required_analyzers=_analyzers(), tenant="late",
+            slo=Slo(deadline_ms=30.0, cls="standard"),
+        )
+        fresh = fleet.submit(
+            table, required_analyzers=_analyzers(), tenant="fresh",
+            slo=Slo(deadline_ms=60_000.0, cls="standard"),
+        )
+        time.sleep(0.08)  # the doomed deadline passes while wedged
+        redispatched = fleet.kill_worker(wid)
+        with pytest.raises(DeadlineExceededException) as e:
+            doomed.result(timeout=60)
+        assert e.value.slo_class == "standard"
+        assert e.value.tenant == "late"
+        assert doomed.resolve_count == 1
+        # a shed is not a re-dispatch: only the fresh request replayed
+        assert redispatched == 1
+        assert fleet.requests_redispatched == 1
+        result = fresh.result(timeout=120)
+        assert all(m.value.is_success for m in result.metrics.values())
+        assert fresh.resolve_count == 1
+        assert any(
+            d.get("kind") == "deadline_shed" and d.get("at") == "failover"
+            for d in SCAN_STATS.degradation_events
+        )
+    finally:
+        fleet.stop(drain=True)
+
+
+def test_router_walk_orders_every_worker_from_placement():
+    router = ConsistentHashRouter()
+    for w in range(4):
+        router.add_worker(w)
+    digest = route_digest(_table(seed=32), _analyzers())
+    walk = router.walk(digest)
+    assert walk[0] == router.place(digest)
+    assert sorted(walk) == [0, 1, 2, 3]  # every worker exactly once
+    # deterministic: the spill order IS the failover order
+    assert walk == router.walk(digest)
+    router.remove_worker(walk[0])
+    assert router.walk(digest)[0] == walk[1]
+    assert ConsistentHashRouter().walk(digest) == []
+
+
+def test_fleet_spills_admission_refusal_to_ring_successor():
+    """Overload spill: when the placed worker refuses admission typed,
+    the submit walks the ring and a worker with headroom takes the
+    request; only when EVERY worker refuses does the typed refusal
+    reach the caller."""
+    from deequ_tpu.exceptions import ServiceOverloadedException
+
+    table = _table(seed=33)
+    fleet = _fleet(
+        n_workers=2,
+        worker_knobs={"coalesce_window": 0.0, "max_pending": 1},
+    )
+    try:
+        wid = fleet.route(table, required_analyzers=_analyzers())
+        other = [w for w in range(2) if w != wid][0]
+        # wedge BOTH workers so queues hold, then fill the placed
+        # worker's single pending slot (the survivor's wedge is short:
+        # it must outlast the submissions below, not the gather)
+        fleet.stall_worker(wid, seconds=30.0)
+        fleet.stall_worker(other, seconds=2.0)
+        time.sleep(0.05)
+        first = fleet.submit(
+            table, required_analyzers=_analyzers(), tenant="a"
+        )
+        # the placed worker is full: this spills to the ring successor
+        spilled = fleet.submit(
+            table, required_analyzers=_analyzers(), tenant="b"
+        )
+        with fleet._lock:
+            assert fleet._assignments[spilled].worker == other
+        # both full: the PLACED worker's typed refusal propagates,
+        # carrying the structured backpressure fields
+        with pytest.raises(ServiceOverloadedException) as e:
+            fleet.submit(table, required_analyzers=_analyzers(), tenant="c")
+        assert e.value.queue_depth == 1
+        assert e.value.retry_after_s is not None
+        # un-wedge by killing: both queued requests still resolve once
+        fleet.kill_worker(wid)
+        for f in (first, spilled):
+            result = f.result(timeout=120)
+            assert all(m.value.is_success for m in result.metrics.values())
+            assert f.resolve_count == 1
+    finally:
+        fleet.stop(drain=True)

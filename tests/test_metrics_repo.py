@@ -175,6 +175,52 @@ def test_persisted_round_trip_and_compaction(tmp_path):
     _assert_results_bit_identical(reopened.load().get(), before)
 
 
+def test_ttl_retention_drops_at_compaction_only(tmp_path, monkeypatch):
+    """Round-15 retention (ROADMAP item-5 leftover): with a TTL armed,
+    compaction drops results wholly older than (newest live date - TTL)
+    — never on the load path — and the SURVIVING window stays
+    bit-identical to an untrimmed repository's loader output. The knob
+    rides envcfg (``DEEQU_TPU_REPO_TTL``) and the constructor alike."""
+    ttl_before = REPO_STATS.ttl_dropped
+    path = str(tmp_path / "repo")
+    repo = ColumnarMetricsRepository(path, segment_rows=8, ttl=10.0)
+    untrimmed = ColumnarMetricsRepository()
+    for d in range(30):
+        result = _scalar_result(d, {"t": "a"}, {"x": d * 0.25})
+        repo.save(result)
+        untrimmed.save(result)
+    # retention is a COMPACTION policy: before one, everything loads
+    assert len(repo.load().get()) == 30
+    dropped = repo.compact()
+    assert dropped == 19  # dates 0..18 fall past horizon 29 - 10 = 19
+    assert REPO_STATS.ttl_dropped == ttl_before + 19
+    survivors = repo.load().get()
+    assert [r.result_key.data_set_date for r in survivors] == list(
+        range(19, 30)
+    )
+    # loader bit-identity over the surviving window vs the untrimmed
+    # reference restricted to the same dates
+    _assert_results_bit_identical(
+        survivors, untrimmed.load().after(19).get()
+    )
+    # durable: a fresh open replays exactly the trimmed history
+    _assert_results_bit_identical(
+        ColumnarMetricsRepository(path).load().get(), survivors
+    )
+    # the envcfg default wires the same knob; garbage is typed
+    from deequ_tpu.exceptions import EnvConfigError
+
+    monkeypatch.setenv("DEEQU_TPU_REPO_TTL", "5")
+    assert ColumnarMetricsRepository().ttl == 5.0
+    monkeypatch.setenv("DEEQU_TPU_REPO_TTL", "0")  # 0 disables
+    assert ColumnarMetricsRepository().ttl is None
+    monkeypatch.setenv("DEEQU_TPU_REPO_TTL", "soon")
+    with pytest.raises(EnvConfigError, match="DEEQU_TPU_REPO_TTL"):
+        ColumnarMetricsRepository()
+    with pytest.raises(ValueError, match="ttl"):
+        ColumnarMetricsRepository(ttl=-1.0)
+
+
 # -- append cost (the fs O(N^2) fix) -----------------------------------------
 
 
