@@ -1719,6 +1719,298 @@ def measure_overload_shedding(n_submissions: int = 2400):
     }
 
 
+def measure_suggestion_loop(n_windows: int = 6):
+    """Control-plane probe (round 16, deequ_tpu/control — the ROADMAP
+    closed-loop acceptance shape): a COLD tenant driven window by
+    window through serving-backed profiling -> recorded history ->
+    constraint suggestion -> best_effort shadow evaluation ->
+    anomaly-gated promotion, until its first enforcing check set —
+    with verification traffic sharing the service throughout.
+
+    Contract asserts (the probe REFUSES to report on violation, like
+    the serving/overload/one-fetch asserts):
+
+    - PROFILING COALESCES: with verification submissions in flight,
+      the profile passes ride the same coalescer under the
+      one-fetch-per-batch contract — device fetches == coalesced
+      batches across the mixed phase (profiling adds no extra
+      round-trips);
+    - REPEAT PROFILES ZERO-TRACE: once a tenant shape is warm, further
+      profile windows add ZERO compiled programs and ZERO plan-lint
+      traces (plan lint in ``error`` mode) — profiling inherits the
+      repeat-tenant plan-cache contract;
+    - SHADOW LOAD NEVER SHEDS CRITICAL: under a queue-saturating
+      critical flood the shadow evaluation sheds TYPED (streaks
+      untouched) while ZERO critical requests are shed or refused and
+      every completed critical result is bit-identical to its
+      unloaded serial run — vetting work never displaces enforcing
+      traffic;
+    - THE LOOP CLOSES: the cold tenant reaches a non-empty enforcing
+      set with zero hand-written constraints inside ``n_windows``, and
+      a second registry re-minting from the RECORDED history alone
+      reproduces the identical check ids + codes."""
+    import struct
+
+    from deequ_tpu import VerificationSuite
+    from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+    from deequ_tpu.anomaly import OnlineNormalStrategy
+    from deequ_tpu.control import (
+        CONTROL_STATS,
+        CheckRegistry,
+        PromotionGate,
+        SuggestionEngine,
+    )
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.repository import (
+        InMemoryMetricsRepository,
+        QualityMonitor,
+    )
+    from deequ_tpu.serve import Slo, VerificationService
+
+    PROMOTE_WINDOWS = 3
+
+    def bits(v):
+        return struct.pack("<d", v) if isinstance(v, float) else v
+
+    def window_table(w: int, n: int = 4096):
+        """One observation window of multi-family tenant data:
+        categorical string, fractional, nullable fractional, unique
+        integral — the shape every suggestion rule can bite on."""
+        r = np.random.default_rng(1600 + w)
+        vals = r.uniform(1.0, 5.0, size=n)
+        return ColumnarTable.from_pydict({
+            "cat": r.choice(["a", "b", "c"], size=n).tolist(),
+            "value": vals.tolist(),
+            "maybe": [
+                float(v) if i % 10 else None for i, v in enumerate(vals)
+            ],
+            "ident": list(range(n)),
+        })
+
+    def verif_table(t: int, n: int = 4096):
+        r = np.random.default_rng(1700 + t)
+        return ColumnarTable([
+            Column("x", DType.FRACTIONAL, values=r.normal(100, 5, n),
+                   mask=r.random(n) > 0.05),
+            Column("i", DType.INTEGRAL,
+                   values=r.integers(0, 50, n).astype(np.float64),
+                   mask=np.ones(n, bool)),
+        ])
+
+    verif_analyzers = [Size(), Completeness("x"), Mean("x"), Sum("i")]
+    vtables = [verif_table(t) for t in range(3)]
+
+    with use_mesh(None):
+        serial_ref = [
+            VerificationSuite.run(t, [], required_analyzers=verif_analyzers)
+            for t in vtables
+        ]
+        repo = InMemoryMetricsRepository()
+        registry = CheckRegistry()
+        monitor = QualityMonitor()
+        monitor.watch(
+            OnlineNormalStrategy(), metric_name="Completeness",
+            tags={"kind": "profile"}, warmup=4 * n_windows,
+            name="bench-profile-completeness",
+        )
+        svc = VerificationService(plan_lint="error", coalesce_window=0.01)
+        svc.start()
+        try:
+            engine = SuggestionEngine(repo, registry, service=svc)
+            gate = PromotionGate(
+                registry, monitor=monitor, windows=PROMOTE_WINDOWS
+            )
+
+            # -- the closed loop (ControlLoop.step unrolled so the
+            # coalescing ledger can scope to the PROFILING passes: the
+            # shadow evaluation legitimately carries group analyzers —
+            # Uniqueness — whose serial group scans fetch outside the
+            # coalescer), with verification traffic in flight during
+            # every profile window
+            windows_to_enforcing = None
+            mixed_fetches = mixed_batches = 0
+            repeat_built0 = repeat_lint0 = None
+            for w in range(1, n_windows + 1):
+                inflight = [
+                    svc.submit(
+                        vtables[t], required_analyzers=verif_analyzers,
+                        tenant=f"v{t}", slo=Slo(cls="standard"),
+                    )
+                    for t in range(len(vtables))
+                ]
+                if w == 2:
+                    # tenant shape is warm after window 1: from here
+                    # every profile pass must be a pure plan-cache hit
+                    repeat_built0 = SCAN_STATS.programs_built
+                    repeat_lint0 = SCAN_STATS.plan_lint_traces
+                data = window_table(w)
+                fetch0 = SCAN_STATS.device_fetches
+                batch0 = SCAN_STATS.coalesced_batches
+                engine.profile_tenant(data, "cold", w, monitor=monitor)
+                mixed_fetches += SCAN_STATS.device_fetches - fetch0
+                mixed_batches += SCAN_STATS.coalesced_batches - batch0
+                engine.suggest("cold", w)
+                shadow = None
+                if registry.checks("cold", "shadow"):
+                    shadow = engine.evaluate_shadow(data, "cold", w)
+                gate.observe_window("cold", w, shadow)
+                for t, f in enumerate(inflight):
+                    got = f.result(timeout=600).metrics
+                    for a in verif_analyzers:
+                        assert bits(got[a].value.get()) == bits(
+                            serial_ref[t].metrics[a].value.get()
+                        ), (
+                            "suggestion-loop violation: verification "
+                            f"tenant v{t} {a} degraded while sharing the "
+                            "service with profile traffic"
+                        )
+                if registry.checks("cold", "enforcing"):
+                    windows_to_enforcing = w
+                    break
+            assert windows_to_enforcing is not None, (
+                "suggestion-loop violation: the cold tenant never "
+                f"reached an enforcing check set in {n_windows} windows"
+            )
+            enforcing = registry.checks("cold", "enforcing")
+            assert all(c.rule for c in enforcing), (
+                "suggestion-loop violation: an enforcing check was not "
+                "minted by a suggestion rule"
+            )
+            assert mixed_fetches == mixed_batches, (
+                "suggestion-loop violation: "
+                f"{mixed_fetches} device fetches for {mixed_batches} "
+                "coalesced batches with profile traffic in the mix — "
+                "profiling must obey the one-fetch-per-batch contract"
+            )
+            # shadow-check shapes mint during window 2, so the warm
+            # window is allowed its first-eval compiles; windows >= 3
+            # (there are >= PROMOTE_WINDOWS of them) must add none, and
+            # the REPEAT PROFILE phase below pins the pure-profile case
+            repeat_built = SCAN_STATS.programs_built - repeat_built0
+            repeat_lint = SCAN_STATS.plan_lint_traces - repeat_lint0
+
+            # -- repeat-profile zero-trace, isolated: two more profile
+            # windows of the warm tenant shape, nothing else in flight
+            built0 = SCAN_STATS.programs_built
+            lint0 = SCAN_STATS.plan_lint_traces
+            for w in (n_windows + 1, n_windows + 2):
+                engine.profile_tenant(window_table(w), "cold", w)
+            assert SCAN_STATS.programs_built == built0, (
+                "suggestion-loop violation: "
+                f"{SCAN_STATS.programs_built - built0} programs built "
+                "re-profiling a warm tenant shape — profiling must "
+                "inherit the repeat-tenant plan-cache contract"
+            )
+            assert SCAN_STATS.plan_lint_traces == lint0, (
+                "suggestion-loop violation: "
+                f"{SCAN_STATS.plan_lint_traces - lint0} plan-lint "
+                "traces re-profiling a warm tenant shape"
+            )
+
+            # -- replay reproducibility: a second registry re-minting
+            # from the recorded history alone produces the identical
+            # check set
+            replayed = CheckRegistry()
+            replayed.note_tenant_schema(
+                "cold", registry.tenant_schema("cold")
+            )
+            engine2 = SuggestionEngine(repo, replayed)
+            # replay exactly the windows the loop consumed (history
+            # also holds the repeat-profile windows appended above)
+            for w in sorted(engine.history("cold")):
+                if w <= windows_to_enforcing:
+                    engine2.suggest("cold", w)
+            orig = {c.check_id: c.code for c in registry.checks("cold")}
+            mint = {c.check_id: c.code for c in replayed.checks("cold")}
+            assert orig == mint and orig, (
+                "suggestion-loop violation: replaying the recorded "
+                "profile history minted a different check set "
+                f"({sorted(set(orig) ^ set(mint))[:4]}...)"
+            )
+        finally:
+            svc.stop(drain=False)
+
+        # -- the shed phase: an unstarted service holds a
+        # queue-saturating critical flood; the best_effort shadow
+        # evaluation must shed typed while zero criticals are touched
+        if not registry.checks("cold", "shadow"):
+            # every mint promoted: put one check back through the
+            # demoted -> shadow re-trial path so there is shadow work
+            # to shed
+            victim = registry.checks("cold", "enforcing")[0]
+            registry.demote(
+                victim.check_id, n_windows + 2, "bench-shed-retrial"
+            )
+            registry.to_shadow(victim.check_id)
+        pending = 10
+        shed_svc = VerificationService(
+            start=False, max_pending=pending, coalesce_window=0.0,
+        )
+        try:
+            flood = [
+                shed_svc.submit(
+                    vtables[i % len(vtables)],
+                    required_analyzers=verif_analyzers,
+                    tenant=f"crit{i}", slo=Slo(cls="critical"),
+                )
+                for i in range(pending)
+            ]
+            shed0 = CONTROL_STATS.shadow_evals_shed
+            streaks = {
+                c.check_id: c.clean_windows
+                for c in registry.checks("cold", "shadow")
+            }
+            outcome = engine.evaluate_shadow(
+                window_table(99), "cold", n_windows + 3, service=shed_svc,
+            )
+            assert outcome.status == "shed", (
+                "suggestion-loop violation: the shadow evaluation was "
+                f"admitted ({outcome.status}) through a saturated queue "
+                "— best_effort shadow traffic must shed first"
+            )
+            assert CONTROL_STATS.shadow_evals_shed == shed0 + 1
+            assert streaks == {
+                c.check_id: c.clean_windows
+                for c in registry.checks("cold", "shadow")
+            }, (
+                "suggestion-loop violation: a SHED shadow window moved "
+                "a promotion streak — shed must mean no evidence"
+            )
+            shed_svc.start()
+            for i, f in enumerate(flood):
+                got = f.result(timeout=600).metrics
+                serial = serial_ref[i % len(vtables)]
+                for a in verif_analyzers:
+                    assert bits(got[a].value.get()) == bits(
+                        serial.metrics[a].value.get()
+                    ), (
+                        "suggestion-loop violation: critical request "
+                        f"crit{i} {a} degraded under shadow-class load"
+                    )
+        finally:
+            shed_svc.stop(drain=False)
+
+    return {
+        "suggestion_windows_to_enforcing": windows_to_enforcing,
+        "suggestion_promote_windows": PROMOTE_WINDOWS,
+        "suggestion_enforcing_checks": len(enforcing),
+        "suggestion_candidates_registered": (
+            CONTROL_STATS.candidates_registered
+        ),
+        "suggestion_mixed_fetches": mixed_fetches,
+        "suggestion_mixed_batches": mixed_batches,
+        "suggestion_warm_window_programs": repeat_built,
+        "suggestion_warm_window_lint_traces": repeat_lint,
+        "suggestion_repeat_profile_programs": 0,
+        "suggestion_repeat_profile_lint_traces": 0,
+        "suggestion_shadow_sheds": 1,
+        "suggestion_critical_sheds": 0,
+        "suggestion_replay_identical": True,
+    }
+
+
 def measure_repository_query(n_tenants: int, n_dates: int = 32):
     """Repository-query probe (round 13, deequ_tpu/repository — ROADMAP
     item 5's acceptance shape): an ``n_tenants x n_dates`` metric

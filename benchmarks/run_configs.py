@@ -210,6 +210,34 @@ def config10(n_submissions: int):
     )
 
 
+def config11(n_windows: int):
+    """CONTROL-PLANE config (round 16, deequ_tpu/control): a cold
+    tenant driven through the closed quality loop — serving-backed
+    profiling, recorded history, constraint suggestion, best_effort
+    shadow evaluation, anomaly-gated promotion — until its first
+    enforcing check set, with verification traffic sharing the
+    service. ONE workload definition, shared with bench.py's
+    ``measure_suggestion_loop`` probe, which hard-asserts — before it
+    reports anything — profile passes coalescing under the
+    one-fetch-per-batch contract (fetches == batches with traffic in
+    the mix), repeat profiles of a warm tenant shape at zero compiled
+    programs + zero plan-lint traces, the shadow-class flood shedding
+    TYPED without ever shedding (or degrading) a critical request, and
+    the whole check set re-minting bit-identically from the recorded
+    profile history alone."""
+    import bench
+
+    probe = bench.measure_suggestion_loop(n_windows)
+    return _emit(
+        config=11, metric="suggestion_windows_to_enforcing",
+        value=probe["suggestion_windows_to_enforcing"], unit="windows",
+        **{
+            k: v for k, v in probe.items()
+            if k != "suggestion_windows_to_enforcing"
+        },
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -741,6 +769,11 @@ def main():
         # survives, typed best_effort sheds, goodput, bit-identity, and
         # the chaos load quick-soak asserted inside)
         10: lambda: config10(args.rows or 2400),
+        # round-16 control-plane config: the closed suggestion ->
+        # shadow -> promotion loop to a cold tenant's first enforcing
+        # check set (profile coalescing / repeat zero-trace / shadow-
+        # never-sheds-critical / replay reproducibility asserted inside)
+        11: lambda: config11(args.rows or 6),
     }
     if args.all:
         for k in sorted(runners):
@@ -753,7 +786,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5,6,7,8,9,10} or --all")
+        ap.error("--config {1,2,3,4,5,6,7,8,9,10,11} or --all")
 
 
 if __name__ == "__main__":

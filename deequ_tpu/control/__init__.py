@@ -1,0 +1,46 @@
+"""Closed-loop quality control plane (round 16).
+
+Fused serving-scale profiling -> online constraint suggestion ->
+anomaly-gated promotion to enforcement: a cold tenant reaches an
+enforcing, anomaly-vetted check set with zero hand-written constraints.
+See docs/control_plane.md for the lifecycle state machine and the
+SLO-class isolation argument.
+"""
+
+from deequ_tpu.control.engine import (
+    PROFILE_KIND,
+    ServeProfileRuns,
+    ShadowOutcome,
+    SuggestionEngine,
+    profile_key,
+)
+from deequ_tpu.control.promotion import (
+    ControlLoop,
+    ControlStep,
+    PromotionGate,
+)
+from deequ_tpu.control.registry import (
+    CONTROL_STATS,
+    LIFECYCLE_STATES,
+    CheckRegistry,
+    DemotionEvent,
+    PromotionEvent,
+    RegisteredCheck,
+)
+
+__all__ = [
+    "CONTROL_STATS",
+    "CheckRegistry",
+    "ControlLoop",
+    "ControlStep",
+    "DemotionEvent",
+    "LIFECYCLE_STATES",
+    "PROFILE_KIND",
+    "PromotionEvent",
+    "PromotionGate",
+    "RegisteredCheck",
+    "ServeProfileRuns",
+    "ShadowOutcome",
+    "SuggestionEngine",
+    "profile_key",
+]

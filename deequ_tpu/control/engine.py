@@ -1,0 +1,542 @@
+"""SuggestionEngine — fused serving-scale profiling + the online
+suggestion loop.
+
+Two pieces:
+
+- :class:`ServeProfileRuns` — the profiler's pass executor backed by a
+  running :class:`~deequ_tpu.serve.VerificationService`. Each profiling
+  pass submits its analyzer set as ``required_analyzers`` through the
+  serving seam, so profile traffic gets a PlanKey, coalesces with
+  verification traffic in the same fused batch, hits the compiled-plan
+  cache on repeat, and obeys the one-fetch contract — profiling is just
+  another analyzer set. Repository reuse/save mirrors
+  ``AnalysisRunner.do_analysis_run`` exactly (load-filter-remaining,
+  typed ``ReusingNotPossibleResultsMissingException``, save the combined
+  context), so offline and serving-backed profiles are interchangeable
+  in the repository.
+
+- :class:`SuggestionEngine` — profiles a tenant through that seam into
+  the metrics repository as a per-tenant time series (ResultKey tags
+  ``{"tenant": ..., "kind": "profile"}``), REPLAYS the recorded series
+  back into :class:`~deequ_tpu.profiles.ColumnProfiles` (the recorded
+  tenant schema in the CheckRegistry supplies the native dtypes that
+  saved metrics cannot carry), runs the replayed profiles through the
+  :class:`~deequ_tpu.suggestions.ConstraintRule` set to mint candidate
+  checks into the registry, and evaluates the tenant's shadow set on
+  live traffic — ONLY in the ``best_effort`` SLO class, so a bad
+  candidate can be shed by the brownout ladder but can never consume
+  critical capacity.
+
+Reproducibility contract: ``suggest()`` is a pure function of the
+repository's recorded profile history plus the recorded schema — replay
+the same history and the same check codes are minted, bit-identically
+(pinned by the tier-1 ctrl suite).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deequ_tpu.analyzers import (
+    ApproxCountDistinct,
+    Completeness,
+    DataType,
+    Histogram,
+    KLLSketch,
+    Maximum,
+    Mean,
+    Minimum,
+    Size,
+    StandardDeviation,
+    Sum,
+)
+from deequ_tpu.analyzers.runner import (
+    AnalyzerContext,
+    _save_or_append_result,
+)
+from deequ_tpu.analyzers.scan import DataTypeInstances, determine_type
+from deequ_tpu.checks import Check, CheckLevel
+from deequ_tpu.constraints import ConstraintStatus
+from deequ_tpu.control.registry import CONTROL_STATS, CheckRegistry, RegisteredCheck
+from deequ_tpu.exceptions import (
+    ControlPlaneException,
+    ReusingNotPossibleResultsMissingException,
+    ServiceOverloadedException,
+)
+from deequ_tpu.profiles.profiler import (
+    DEFAULT_CARDINALITY_THRESHOLD,
+    ColumnProfile,
+    ColumnProfiler,
+    ColumnProfiles,
+    NumericColumnProfile,
+    StandardColumnProfile,
+)
+from deequ_tpu.repository import ResultKey
+from deequ_tpu.serve.admission import Slo
+from deequ_tpu.suggestions.runner import Rules
+
+#: ResultKey tags marking one tenant's profile series in the repository
+PROFILE_KIND = "profile"
+
+
+def profile_key(tenant: str, window: int) -> ResultKey:
+    """The repository key of one tenant profile window."""
+    return ResultKey(window, {"tenant": str(tenant), "kind": PROFILE_KIND})
+
+
+class ServeProfileRuns:
+    """Serving-backed profiling pass executor (the ``runs`` seam of
+    :meth:`ColumnProfiler.profile`): each pass's analyzer set goes
+    through ``service.submit(required_analyzers=...)`` instead of an
+    offline fused scan. Reuse/save semantics mirror
+    ``AnalysisRunner.do_analysis_run`` (runner.py step 1 / step 6)."""
+
+    def __init__(
+        self,
+        service,
+        tenant: Optional[str] = None,
+        slo: Optional[Slo] = None,
+        metrics_repository=None,
+        reuse_key: Optional[ResultKey] = None,
+        fail_if_missing: bool = False,
+        save_key: Optional[ResultKey] = None,
+        timeout: Optional[float] = 120.0,
+    ):
+        self.service = service
+        self.tenant = tenant
+        self.slo = slo
+        self.metrics_repository = metrics_repository
+        self.reuse_key = reuse_key
+        self.fail_if_missing = fail_if_missing
+        self.save_key = save_key
+        self.timeout = timeout
+
+    def run(self, table, analyzers) -> AnalyzerContext:
+        """One profiling pass -> AnalyzerContext (the serving twin of
+        ``OfflineProfileRuns.run``)."""
+        analyzers = list(analyzers)
+        results_loaded = AnalyzerContext.empty()
+        if self.metrics_repository is not None and self.reuse_key is not None:
+            existing = self.metrics_repository.load_by_key(self.reuse_key)
+            if existing is not None:
+                results_loaded = AnalyzerContext(
+                    {
+                        a: m
+                        for a, m in existing.analyzer_context.metric_map.items()
+                        if a in analyzers
+                    }
+                )
+        remaining = [
+            a for a in analyzers if a not in results_loaded.metric_map
+        ]
+        if self.fail_if_missing and remaining:
+            raise ReusingNotPossibleResultsMissingException(
+                "Could not find all necessary results in the "
+                "MetricsRepository, the calculation of the metrics for "
+                "these analyzers would be needed: "
+                + ", ".join(str(a) for a in remaining)
+            )
+        computed = AnalyzerContext.empty()
+        if remaining:
+            future = self.service.submit(
+                table,
+                required_analyzers=remaining,
+                tenant=self.tenant,
+                slo=self.slo,
+            )
+            verification = future.result(self.timeout)
+            computed = AnalyzerContext(
+                {
+                    a: m
+                    for a, m in verification.metrics.items()
+                    if a in remaining
+                }
+            )
+            CONTROL_STATS.profile_submits += 1
+        result = results_loaded + computed
+        _save_or_append_result(
+            self.metrics_repository, self.save_key, result
+        )
+        return result
+
+
+@dataclass(frozen=True)
+class ShadowOutcome:
+    """One shadow-evaluation window's result. ``status`` is ``"passed"``
+    / ``"failed"`` (at least one shadow constraint failed; the offenders
+    are in ``failed_check_ids``) / ``"shed"`` (the best_effort submission
+    was load-shed typed — no evidence either way) / ``"empty"`` (the
+    tenant has no shadow checks)."""
+
+    tenant: str
+    window: int
+    status: str
+    failed_check_ids: Tuple[str, ...] = ()
+    verification_result: object = None
+
+
+_SCHEMA_NATIVE_TYPES = {
+    "INTEGRAL": DataTypeInstances.INTEGRAL,
+    "FRACTIONAL": DataTypeInstances.FRACTIONAL,
+    "BOOLEAN": DataTypeInstances.BOOLEAN,
+}
+
+
+class SuggestionEngine:
+    """The online suggestion loop (module doc). ``service=None`` runs
+    profiling offline through the same repository seam — useful for
+    backfills; the serving path is the product shape."""
+
+    def __init__(
+        self,
+        repository,
+        registry: CheckRegistry,
+        rules: Optional[Sequence] = None,
+        service=None,
+        slo: Optional[Slo] = None,
+    ):
+        self.repository = repository
+        self.registry = registry
+        self.rules = list(rules) if rules is not None else list(Rules.DEFAULT)
+        self.service = service
+        self.slo = slo
+
+    # -- profiling into the repository -----------------------------------
+
+    def profile_tenant(
+        self,
+        data,
+        tenant: str,
+        window: int,
+        service=None,
+        slo: Optional[Slo] = None,
+        kll_profiling: bool = False,
+        kll_parameters=None,
+        low_cardinality_histogram_threshold: int = (
+            DEFAULT_CARDINALITY_THRESHOLD
+        ),
+        monitor=None,
+    ) -> ColumnProfiles:
+        """Profile one window of a tenant's data into the repository
+        (key: :func:`profile_key`), recording the native schema in the
+        registry so :meth:`replay` can reconstruct the profiles later.
+        When a service is available the passes ride the serving seam;
+        ``monitor`` (a QualityMonitor) additionally folds the saved
+        window into its watched profile series."""
+        service = service if service is not None else self.service
+        slo = slo if slo is not None else self.slo
+        key = profile_key(tenant, window)
+        self.registry.note_tenant_schema(
+            tenant, {name: data[name].dtype.name for name in data.column_names}
+        )
+        if service is not None:
+            runs = ServeProfileRuns(
+                service,
+                tenant=tenant,
+                slo=slo,
+                metrics_repository=self.repository,
+                save_key=key,
+            )
+            profiles = ColumnProfiler.profile(
+                data,
+                low_cardinality_histogram_threshold=(
+                    low_cardinality_histogram_threshold
+                ),
+                kll_profiling=kll_profiling,
+                kll_parameters=kll_parameters,
+                runs=runs,
+            )
+        else:
+            profiles = ColumnProfiler.profile(
+                data,
+                low_cardinality_histogram_threshold=(
+                    low_cardinality_histogram_threshold
+                ),
+                metrics_repository=self.repository,
+                save_in_metrics_repository_using_key=key,
+                kll_profiling=kll_profiling,
+                kll_parameters=kll_parameters,
+            )
+            CONTROL_STATS.profile_submits += 1
+        # a ColumnarMetricsRepository with this monitor attached already
+        # observed the saves at its save seam; feed the window explicitly
+        # only for repositories without the attachment (the monitor's
+        # per-series stale-point gate makes an accidental double-feed a
+        # no-op anyway)
+        if (
+            monitor is not None
+            and getattr(self.repository, "monitor", None) is not monitor
+        ):
+            saved = self.repository.load_by_key(key)
+            if saved is not None:
+                monitor.observe_result(saved)
+        return profiles
+
+    # -- replay from the repository --------------------------------------
+
+    def history(self, tenant: str) -> List[int]:
+        """The tenant's recorded profile windows, ascending."""
+        results = (
+            self.repository.load()
+            .with_tag_values({"tenant": str(tenant), "kind": PROFILE_KIND})
+            .get()
+        )
+        return sorted(r.result_key.data_set_date for r in results)
+
+    def replay(
+        self, tenant: str, window: Optional[int] = None
+    ) -> ColumnProfiles:
+        """Reconstruct a tenant's :class:`ColumnProfiles` from the
+        repository's recorded profile series (latest window when
+        ``window`` is None) plus the registry's recorded schema — no
+        access to the original data. Raises typed
+        :class:`ControlPlaneException` when either record is missing."""
+        if window is None:
+            windows = self.history(tenant)
+            if not windows:
+                raise ControlPlaneException(
+                    f"no recorded profile history for tenant {tenant!r}"
+                )
+            window = windows[-1]
+        saved = self.repository.load_by_key(profile_key(tenant, window))
+        if saved is None:
+            raise ControlPlaneException(
+                f"no recorded profile for tenant {tenant!r} at window "
+                f"{window}"
+            )
+        schema = self.registry.tenant_schema(tenant)
+        if schema is None:
+            raise ControlPlaneException(
+                f"no recorded schema for tenant {tenant!r} — profile the "
+                "tenant through this engine first"
+            )
+        CONTROL_STATS.profile_replays += 1
+        return _profiles_from_context(saved.analyzer_context, schema)
+
+    # -- minting candidates ----------------------------------------------
+
+    def suggest(
+        self, tenant: str, window: Optional[int] = None
+    ) -> List[RegisteredCheck]:
+        """Replay the tenant's profile history and run it through the
+        rule set, minting each applicable suggestion into the registry
+        (idempotent by check id; a moved threshold records an
+        adaptation). Fresh candidates advance to shadow immediately —
+        they carry zero enforcement weight there. Returns the tenant's
+        registered checks touched this round."""
+        profiles = self.replay(tenant, window)
+        minted: List[RegisteredCheck] = []
+        for name, profile in profiles.profiles.items():
+            for rule in self.rules:
+                if not rule.should_be_applied(profile, profiles.num_records):
+                    continue
+                suggestion = rule.candidate(profile, profiles.num_records)
+                check_id = f"{tenant}:{name}:{type(rule).__name__}"
+                check = self.registry.register_candidate(
+                    check_id=check_id,
+                    tenant=str(tenant),
+                    column=name,
+                    rule=type(rule).__name__,
+                    code=suggestion.code_for_constraint,
+                    description=suggestion.description,
+                    current_value=suggestion.current_value,
+                    constraint=suggestion.constraint,
+                )
+                if check.state == "candidate":
+                    check = self.registry.to_shadow(check_id)
+                minted.append(check)
+        return minted
+
+    # -- building + evaluating checks ------------------------------------
+
+    def _bound_checks(
+        self, tenant: str, state: str
+    ) -> List[RegisteredCheck]:
+        checks = sorted(
+            self.registry.checks(tenant=str(tenant), state=state),
+            key=lambda c: c.check_id,
+        )
+        unbound = [c.check_id for c in checks if c.constraint is None]
+        if unbound:
+            raise ControlPlaneException(
+                f"checks {unbound} have no bound constraint (state was "
+                "resumed from disk) — re-mint them by replaying history: "
+                "SuggestionEngine.suggest()"
+            )
+        return checks
+
+    def build_check(
+        self,
+        tenant: str,
+        state: str = "enforcing",
+        level: CheckLevel = CheckLevel.ERROR,
+        description: Optional[str] = None,
+    ) -> Optional[Check]:
+        """The tenant's registered checks in ``state`` as ONE executable
+        Check (None when the tenant has none). Constraints order by
+        check id, so the built check is deterministic."""
+        checks = self._bound_checks(tenant, state)
+        if not checks:
+            return None
+        return Check(
+            level,
+            description or f"control:{tenant}:{state}",
+            tuple(c.constraint for c in checks),
+        )
+
+    def evaluate_shadow(
+        self,
+        data,
+        tenant: str,
+        window: int,
+        service=None,
+        slo: Optional[Slo] = None,
+        timeout: Optional[float] = 120.0,
+    ) -> ShadowOutcome:
+        """Evaluate the tenant's shadow set on one window of live data,
+        strictly in the ``best_effort`` SLO class: an overloaded service
+        sheds the evaluation typed (outcome ``"shed"``) instead of
+        competing with enforcing traffic. Any other SLO class raises
+        typed — shadow checks must never be able to consume critical
+        capacity."""
+        service = service if service is not None else self.service
+        if service is None:
+            raise ControlPlaneException(
+                "evaluate_shadow needs a running VerificationService"
+            )
+        slo = slo if slo is not None else (self.slo or Slo(cls="best_effort"))
+        if slo.cls != "best_effort":
+            raise ControlPlaneException(
+                "shadow checks are admitted ONLY in the best_effort SLO "
+                f"class, got {slo.cls!r}"
+            )
+        shadow = self._bound_checks(tenant, "shadow")
+        if not shadow:
+            return ShadowOutcome(str(tenant), window, "empty")
+        id_by_constraint = {id(c.constraint): c.check_id for c in shadow}
+        check = Check(
+            CheckLevel.WARNING,
+            f"shadow:{tenant}",
+            tuple(c.constraint for c in shadow),
+        )
+        try:
+            result = service.submit(
+                data, checks=(check,), tenant=tenant, slo=slo
+            ).result(timeout)
+        except ServiceOverloadedException:
+            # typed shed (admission refusal, class budget, brownout, or
+            # deadline): the window produced no evidence — count it and
+            # report it, never fail the loop
+            CONTROL_STATS.shadow_evals_shed += 1
+            return ShadowOutcome(str(tenant), window, "shed")
+        failed: List[str] = []
+        for check_result in result.check_results.values():
+            for cr in check_result.constraint_results:
+                if cr.status != ConstraintStatus.SUCCESS:
+                    check_id = id_by_constraint.get(id(cr.constraint))
+                    if check_id is not None:
+                        failed.append(check_id)
+        failed_ids = tuple(sorted(set(failed)))
+        for c in shadow:
+            if c.check_id in failed_ids:
+                CONTROL_STATS.shadow_evals_failed += 1
+            else:
+                CONTROL_STATS.shadow_evals_passed += 1
+        return ShadowOutcome(
+            str(tenant), window,
+            "failed" if failed_ids else "passed",
+            failed_ids, result,
+        )
+
+
+def _profiles_from_context(
+    ctx: AnalyzerContext, schema: Dict[str, str]
+) -> ColumnProfiles:
+    """Reconstruct :class:`ColumnProfiles` from one saved profile
+    window's metrics + the recorded native schema — the inverse of the
+    profiler's three passes. Columns missing their pass-1 metrics are
+    skipped (they were not profiled in that window)."""
+    size_metric = ctx.metric_map.get(Size())
+    if size_metric is None or not size_metric.value.is_success:
+        raise ControlPlaneException(
+            "recorded profile window has no Size metric — not a profile "
+            "series entry"
+        )
+    num_records = int(size_metric.value.get())
+
+    profiles: Dict[str, ColumnProfile] = {}
+    for name, dtype_name in schema.items():
+        completeness_metric = ctx.metric_map.get(Completeness(name))
+        distinct_metric = ctx.metric_map.get(ApproxCountDistinct(name))
+        if completeness_metric is None or distinct_metric is None:
+            continue
+        completeness = completeness_metric.value.get_or_else(float("nan"))
+        approx_distinct = int(
+            round(distinct_metric.value.get_or_else(0.0))
+        )
+        type_counts: Dict[str, int] = {}
+        if dtype_name == "STRING":
+            is_inferred = True
+            dtype_metric = ctx.metric_map.get(DataType(name))
+            if dtype_metric is not None and dtype_metric.value.is_success:
+                dist = dtype_metric.value.get()
+                inferred = determine_type(dist)
+                type_counts = {
+                    k: v.absolute for k, v in dist.values.items()
+                }
+            else:
+                inferred = DataTypeInstances.UNKNOWN
+        else:
+            is_inferred = False
+            inferred = _SCHEMA_NATIVE_TYPES.get(
+                dtype_name, DataTypeInstances.UNKNOWN
+            )
+        histogram = None
+        histogram_metric = ctx.metric_map.get(Histogram(name))
+        if histogram_metric is not None and histogram_metric.value.is_success:
+            histogram = histogram_metric.value.get()
+
+        base = dict(
+            column=name,
+            completeness=completeness,
+            approximate_num_distinct_values=approx_distinct,
+            data_type=inferred,
+            is_data_type_inferred=is_inferred,
+            type_counts=type_counts,
+            histogram=histogram,
+        )
+        if inferred in (
+            DataTypeInstances.INTEGRAL, DataTypeInstances.FRACTIONAL
+        ):
+            def metric_value(analyzer):
+                m = ctx.metric_map.get(analyzer)
+                if m is not None and m.value.is_success:
+                    return float(m.value.get())
+                return None
+
+            kll_dist = None
+            approx_percentiles = None
+            for analyzer, metric in ctx.metric_map.items():
+                if (
+                    isinstance(analyzer, KLLSketch)
+                    and analyzer.column == name
+                    and metric.value.is_success
+                ):
+                    kll_dist = metric.value.get()
+                    approx_percentiles = kll_dist.compute_percentiles()
+                    break
+            profiles[name] = NumericColumnProfile(
+                **base,
+                kll=kll_dist,
+                mean=metric_value(Mean(name)),
+                maximum=metric_value(Maximum(name)),
+                minimum=metric_value(Minimum(name)),
+                sum=metric_value(Sum(name)),
+                std_dev=metric_value(StandardDeviation(name)),
+                approx_percentiles=approx_percentiles,
+            )
+        else:
+            profiles[name] = StandardColumnProfile(**base)
+
+    return ColumnProfiles(profiles, num_records)

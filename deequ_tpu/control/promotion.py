@@ -1,0 +1,162 @@
+"""PromotionGate — anomaly-gated promotion of shadow checks.
+
+The gate closes the loop: the QualityMonitor (repository/monitor.py)
+watches each tenant's recorded profile series, and every observation
+window the gate folds two signals into the CheckRegistry —
+
+- the monitor's anomaly alerts for the tenant at that window, and
+- the window's :class:`~deequ_tpu.control.engine.ShadowOutcome`
+  (shadow constraints that failed on live data);
+
+a shadow check accumulates ``clean_windows`` across anomaly-free,
+shadow-passing windows and is PROMOTED to enforcing at
+``DEEQU_TPU_PROMOTE_WINDOWS`` consecutive clean windows; any dirty
+window resets the streak, and a dirty window DEMOTES an already
+enforcing check (typed reason ``"anomaly"``). A shed shadow window
+(the best_effort evaluation was load-shed) is no evidence either way:
+the streak neither grows nor resets.
+
+Exactly-once: every fold goes through ``CheckRegistry.record_window``,
+whose persisted per-check ``last_window`` watermark makes replayed
+windows no-ops — so kill-and-resume re-observing the same history can
+never append a promotion or demotion event twice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from deequ_tpu.control.engine import ShadowOutcome, SuggestionEngine
+from deequ_tpu.control.registry import CheckRegistry, RegisteredCheck
+
+
+@dataclass
+class ControlStep:
+    """One closed-loop cycle's outputs (see :class:`ControlLoop`)."""
+
+    tenant: str
+    window: int
+    minted: List[RegisteredCheck] = field(default_factory=list)
+    shadow: Optional[ShadowOutcome] = None
+    events: List[Any] = field(default_factory=list)
+
+
+class PromotionGate:
+    """Folds per-window anomaly + shadow evidence into the registry's
+    lifecycle (module doc). ``windows`` overrides the envcfg promotion
+    threshold (``DEEQU_TPU_PROMOTE_WINDOWS``)."""
+
+    def __init__(
+        self,
+        registry: CheckRegistry,
+        monitor=None,
+        windows: Optional[int] = None,
+    ):
+        self.registry = registry
+        self.monitor = monitor
+        if windows is None:
+            from deequ_tpu.envcfg import env_value
+
+            windows = env_value("DEEQU_TPU_PROMOTE_WINDOWS")
+        self.windows = int(windows)
+
+    def anomalous(self, tenant: str, window: int) -> bool:
+        """True when the monitor holds an alert for this tenant's series
+        at this window (series keys embed the sorted tag JSON, so the
+        tenant tag is matchable as a literal fragment)."""
+        if self.monitor is None:
+            return False
+        import json
+
+        # monitor series embed tags compact (separators=(',',':')) — the
+        # fragment must match byte-for-byte
+        tag_fragment = json.dumps(
+            {"tenant": str(tenant)}, separators=(",", ":")
+        )[1:-1]
+        return any(
+            alert.time == window and tag_fragment in alert.series
+            for alert in self.monitor.alerts
+        )
+
+    def observe_window(
+        self,
+        tenant: str,
+        window: int,
+        shadow_outcome: Optional[ShadowOutcome] = None,
+    ) -> List[Any]:
+        """Fold one window for every shadow + enforcing check of the
+        tenant; returns the typed promotion/demotion events appended
+        (each exactly once — replays no-op on the watermark)."""
+        anomaly = self.anomalous(tenant, window)
+        shed = (
+            shadow_outcome is not None and shadow_outcome.status == "shed"
+        )
+        failed = (
+            set(shadow_outcome.failed_check_ids)
+            if shadow_outcome is not None
+            else set()
+        )
+        events: List[Any] = []
+        for check in self.registry.checks(tenant=str(tenant), state="shadow"):
+            if anomaly or check.check_id in failed:
+                verdict = "dirty"
+            elif shed:
+                verdict = "shed"
+            else:
+                verdict = "clean"
+            event = self.registry.record_window(
+                check.check_id, window, verdict, self.windows
+            )
+            if event is not None:
+                events.append(event)
+        for check in self.registry.checks(
+            tenant=str(tenant), state="enforcing"
+        ):
+            event = self.registry.record_window(
+                check.check_id, window,
+                "dirty" if anomaly else "clean", self.windows,
+            )
+            if event is not None:
+                events.append(event)
+        return events
+
+
+class ControlLoop:
+    """The whole closed loop as one object: profile -> suggest ->
+    shadow-evaluate -> gate, once per observation window. This is the
+    cold-tenant path the bench probe drives: a tenant with zero
+    hand-written constraints reaches an enforcing, anomaly-vetted check
+    set after ``windows`` clean cycles."""
+
+    def __init__(self, engine: SuggestionEngine, gate: PromotionGate):
+        self.engine = engine
+        self.gate = gate
+
+    def step(
+        self,
+        data,
+        tenant: str,
+        window: int,
+        service=None,
+        slo=None,
+    ) -> ControlStep:
+        self.engine.profile_tenant(
+            data, tenant, window, service=service,
+            monitor=self.gate.monitor,
+        )
+        minted = self.engine.suggest(tenant, window)
+        shadow = None
+        if self.registry.checks(tenant=str(tenant), state="shadow"):
+            shadow = self.engine.evaluate_shadow(
+                data, tenant, window, service=service, slo=slo,
+            )
+        events = self.gate.observe_window(tenant, window, shadow)
+        return ControlStep(
+            tenant=str(tenant), window=window, minted=minted,
+            shadow=shadow, events=events,
+        )
+
+    @property
+    def registry(self) -> CheckRegistry:
+        return self.engine.registry

@@ -1,0 +1,475 @@
+"""CheckRegistry — the control plane's typed check-lifecycle store.
+
+Every check the suggestion loop mints lives here with a typed lifecycle:
+
+    candidate -> shadow -> enforcing -> demoted -> (shadow, re-trial)
+
+- ``candidate`` — freshly minted from a tenant's replayed profile
+  history; not evaluated against anything yet;
+- ``shadow`` — evaluated on live traffic, but ONLY in the ``best_effort``
+  SLO class (serve/admission.py): a bad candidate can be shed by the
+  brownout ladder, never consume critical capacity, and its failures
+  carry zero enforcement weight;
+- ``enforcing`` — promoted by the anomaly gate (control/promotion.py)
+  after ``DEEQU_TPU_PROMOTE_WINDOWS`` consecutive clean windows; part of
+  the tenant's enforcing check set;
+- ``demoted`` — an enforcing check the gate pulled back after anomaly
+  feedback; excluded from enforcement, eligible for re-trial as shadow.
+
+Transitions append typed :class:`PromotionEvent` / :class:`DemotionEvent`
+records with a registry-monotone ``seq``; state persists through the PR-2
+atomic serde (write-temp-fsync-rename + checksum envelope -> typed
+``CorruptStateException`` on a torn file), so the lifecycle — events
+included, each exactly once — survives kill-and-resume. Replayed windows
+are idempotent: every check carries a ``last_window`` watermark, and an
+observation at a time <= the watermark is a no-op (the same stale-point
+gate the QualityMonitor uses).
+
+Constraints themselves are NOT persisted (they close over thresholds as
+lambdas): the registry stores the minting rule + code + thresholds, and
+the SuggestionEngine re-mints them bit-identically by replaying the
+repository's recorded profile history — the registry then re-binds by
+``check_id``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from deequ_tpu.exceptions import ControlPlaneException, CorruptStateException
+
+STATE_VERSION = 1
+STATE_FILE = "control-registry.json"
+
+LIFECYCLE_STATES = ("candidate", "shadow", "enforcing", "demoted")
+
+#: legal lifecycle transitions (from -> allowed targets)
+_TRANSITIONS = {
+    "candidate": ("shadow",),
+    "shadow": ("enforcing", "demoted"),
+    "enforcing": ("demoted",),
+    "demoted": ("shadow",),
+}
+
+
+class _ControlStats:
+    """Control-plane counters scraped by the obs registry's ``control``
+    section (obs/registry.py). ``checks_by_state`` mirrors the most
+    recently mutated registry (last-writer-wins across registries, the
+    SERVE_BROWNOUT_LEVEL precedent — one registry per process is the
+    normal shape)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.candidates_registered = 0
+        self.promotions = 0
+        self.demotions = 0
+        self.adaptations = 0
+        self.shadow_evals_passed = 0
+        self.shadow_evals_failed = 0
+        self.shadow_evals_shed = 0
+        self.profile_submits = 0
+        self.profile_replays = 0
+        self.registry_checkpoints = 0
+        self.registry_resumes = 0
+        self.checks_by_state: Dict[str, int] = {
+            s: 0 for s in LIFECYCLE_STATES
+        }
+
+    def snapshot(self) -> dict:
+        return {
+            "candidates_registered": self.candidates_registered,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+            "adaptations": self.adaptations,
+            "shadow_evals_passed": self.shadow_evals_passed,
+            "shadow_evals_failed": self.shadow_evals_failed,
+            "shadow_evals_shed": self.shadow_evals_shed,
+            "profile_submits": self.profile_submits,
+            "profile_replays": self.profile_replays,
+            "registry_checkpoints": self.registry_checkpoints,
+            "registry_resumes": self.registry_resumes,
+            "checks_by_state": dict(self.checks_by_state),
+        }
+
+
+CONTROL_STATS = _ControlStats()
+
+
+@dataclass(frozen=True)
+class PromotionEvent:
+    """One shadow -> enforcing transition (exactly once per transition;
+    ``seq`` is registry-monotone and persisted with the state)."""
+
+    seq: int
+    check_id: str
+    tenant: str
+    window: int
+    clean_windows: int
+
+    kind: str = "promotion"
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": self.kind, "seq": self.seq, "check_id": self.check_id,
+            "tenant": self.tenant, "window": self.window,
+            "clean_windows": self.clean_windows,
+        }
+
+
+@dataclass(frozen=True)
+class DemotionEvent:
+    """One enforcing -> demoted transition, carrying the typed reason
+    (``"anomaly"`` — profile-series anomaly feedback; ``"shadow_failed"``
+    never demotes an enforcing check, it only resets a shadow streak)."""
+
+    seq: int
+    check_id: str
+    tenant: str
+    window: int
+    reason: str
+
+    kind: str = "demotion"
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": self.kind, "seq": self.seq, "check_id": self.check_id,
+            "tenant": self.tenant, "window": self.window,
+            "reason": self.reason,
+        }
+
+
+@dataclass
+class RegisteredCheck:
+    """One minted check's lifecycle record. ``code`` is the executable
+    snippet the suggestion rule emitted (the reproducibility observable:
+    re-minting from replayed history must produce the same code);
+    ``current_value`` the profile statistic it was minted from."""
+
+    check_id: str
+    tenant: str
+    column: str
+    rule: str
+    state: str
+    code: str
+    description: str
+    current_value: str
+    clean_windows: int = 0
+    last_window: int = -1
+    adaptations: int = 0
+    #: bound at mint/re-mint time by the SuggestionEngine; NOT persisted
+    #: (constraints close over thresholds as lambdas) — None after a
+    #: resume until the engine re-minted
+    constraint: Any = field(default=None, repr=False, compare=False)
+
+    def as_blob(self) -> dict:
+        return {
+            "check_id": self.check_id, "tenant": self.tenant,
+            "column": self.column, "rule": self.rule, "state": self.state,
+            "code": self.code, "description": self.description,
+            "current_value": self.current_value,
+            "clean_windows": self.clean_windows,
+            "last_window": self.last_window,
+            "adaptations": self.adaptations,
+        }
+
+
+def _event_from_blob(blob: dict):
+    if blob.get("kind") == "promotion":
+        return PromotionEvent(
+            seq=blob["seq"], check_id=blob["check_id"],
+            tenant=blob["tenant"], window=blob["window"],
+            clean_windows=blob["clean_windows"],
+        )
+    if blob.get("kind") == "demotion":
+        return DemotionEvent(
+            seq=blob["seq"], check_id=blob["check_id"],
+            tenant=blob["tenant"], window=blob["window"],
+            reason=blob["reason"],
+        )
+    raise CorruptStateException(
+        "control-registry state", f"unknown event kind {blob.get('kind')!r}"
+    )
+
+
+class CheckRegistry:
+    """The lifecycle store (see module doc). Thread-safe: the suggestion
+    engine, the promotion gate, and obs scrapes touch it concurrently.
+
+    ``state_dir=None`` keeps the registry in-memory (tests, exploration);
+    with a directory every mutation checkpoints atomically."""
+
+    def __init__(self, state_dir: Optional[str] = None, retry=None):
+        self._checks: Dict[str, RegisteredCheck] = {}
+        self._events: List[Any] = []
+        self._schemas: Dict[str, Dict[str, str]] = {}
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._fs = None
+        self.state_dir = None
+        if state_dir is not None:
+            from deequ_tpu.data.fs import filesystem_for, strip_scheme
+            from deequ_tpu.resilience.retry import RetryingFileSystem
+
+            self.state_dir = strip_scheme(state_dir)
+            self._fs = RetryingFileSystem(filesystem_for(state_dir), retry)
+            self._load_state()
+
+    # -- registration + lifecycle ----------------------------------------
+
+    def register_candidate(
+        self, check_id: str, tenant: str, column: str, rule: str,
+        code: str, description: str, current_value: str, constraint=None,
+    ) -> RegisteredCheck:
+        """Idempotent mint: a known ``check_id`` re-binds its constraint
+        (and, when the rule's threshold moved, records an adaptation —
+        the auto-tighten/loosen path) instead of re-registering."""
+        with self._lock:
+            existing = self._checks.get(check_id)
+            if existing is not None:
+                existing.constraint = constraint
+                if existing.code != code:
+                    # threshold adaptation: same check identity, new
+                    # bound minted from newer history — the clean streak
+                    # restarts (the check being vetted changed)
+                    existing.code = code
+                    existing.description = description
+                    existing.current_value = current_value
+                    existing.adaptations += 1
+                    if existing.state == "shadow":
+                        existing.clean_windows = 0
+                    CONTROL_STATS.adaptations += 1
+                    self._checkpoint_locked()
+                return existing
+            check = RegisteredCheck(
+                check_id=check_id, tenant=tenant, column=column, rule=rule,
+                state="candidate", code=code, description=description,
+                current_value=current_value, constraint=constraint,
+            )
+            self._checks[check_id] = check
+            CONTROL_STATS.candidates_registered += 1
+            self._sync_state_gauge_locked()
+            self._checkpoint_locked()
+            return check
+
+    def _transition_locked(self, check: RegisteredCheck, to: str) -> None:
+        if to not in _TRANSITIONS.get(check.state, ()):
+            raise ControlPlaneException(
+                f"illegal lifecycle transition {check.state!r} -> {to!r} "
+                f"for check {check.check_id!r}"
+            )
+        check.state = to
+        self._sync_state_gauge_locked()
+
+    def to_shadow(self, check_id: str) -> RegisteredCheck:
+        """candidate -> shadow (or demoted -> shadow for a re-trial);
+        the shadow streak starts at zero."""
+        with self._lock:
+            check = self._require_locked(check_id)
+            self._transition_locked(check, "shadow")
+            check.clean_windows = 0
+            self._checkpoint_locked()
+            return check
+
+    def promote(self, check_id: str, window: int) -> PromotionEvent:
+        """shadow -> enforcing, appending the exactly-once typed event."""
+        with self._lock:
+            check = self._require_locked(check_id)
+            self._transition_locked(check, "enforcing")
+            self._seq += 1
+            event = PromotionEvent(
+                seq=self._seq, check_id=check_id, tenant=check.tenant,
+                window=window, clean_windows=check.clean_windows,
+            )
+            self._events.append(event)
+            CONTROL_STATS.promotions += 1
+            self._checkpoint_locked()
+            return event
+
+    def demote(self, check_id: str, window: int, reason: str) -> DemotionEvent:
+        """enforcing (or shadow) -> demoted, with the typed reason."""
+        with self._lock:
+            check = self._require_locked(check_id)
+            self._transition_locked(check, "demoted")
+            check.clean_windows = 0
+            self._seq += 1
+            event = DemotionEvent(
+                seq=self._seq, check_id=check_id, tenant=check.tenant,
+                window=window, reason=reason,
+            )
+            self._events.append(event)
+            CONTROL_STATS.demotions += 1
+            self._checkpoint_locked()
+            return event
+
+    def record_window(
+        self, check_id: str, window: int, verdict: str,
+        promote_after: int,
+    ) -> Optional[Any]:
+        """Fold one observation window into a check's lifecycle.
+
+        ``verdict`` is ``"clean"`` (no anomaly, shadow eval passed),
+        ``"dirty"`` (anomaly alert or shadow failure) or ``"shed"`` (the
+        best_effort shadow eval was load-shed — no evidence either way:
+        the streak neither grows nor resets). Windows at or below the
+        persisted ``last_window`` watermark are no-ops, which is what
+        makes replay after kill-and-resume exactly-once: the promotion /
+        demotion event for a window can only ever be appended the first
+        time that window is folded in.
+
+        Returns the typed event when the fold crossed a lifecycle edge
+        (promotion at ``promote_after`` consecutive clean windows;
+        demotion of an enforcing check on a dirty window), else None.
+        """
+        if verdict not in ("clean", "dirty", "shed"):
+            raise ControlPlaneException(
+                f"unknown window verdict {verdict!r} for {check_id!r}"
+            )
+        with self._lock:
+            check = self._require_locked(check_id)
+            if window <= check.last_window:
+                return None  # replayed window: already folded in
+            check.last_window = window
+            event: Optional[Any] = None
+            if check.state == "shadow":
+                if verdict == "clean":
+                    check.clean_windows += 1
+                    if check.clean_windows >= promote_after:
+                        return self.promote(check_id, window)
+                elif verdict == "dirty":
+                    check.clean_windows = 0
+            elif check.state == "enforcing" and verdict == "dirty":
+                return self.demote(check_id, window, "anomaly")
+            self._checkpoint_locked()
+            return event
+
+    def _require_locked(self, check_id: str) -> RegisteredCheck:
+        check = self._checks.get(check_id)
+        if check is None:
+            raise ControlPlaneException(f"unknown check {check_id!r}")
+        return check
+
+    # -- views ------------------------------------------------------------
+
+    def checks(
+        self, tenant: Optional[str] = None, state: Optional[str] = None,
+    ) -> List[RegisteredCheck]:
+        with self._lock:
+            return [
+                c for c in self._checks.values()
+                if (tenant is None or c.tenant == tenant)
+                and (state is None or c.state == state)
+            ]
+
+    def get(self, check_id: str) -> Optional[RegisteredCheck]:
+        with self._lock:
+            return self._checks.get(check_id)
+
+    @property
+    def events(self) -> List[Any]:
+        with self._lock:
+            return list(self._events)
+
+    def note_tenant_schema(self, tenant: str, schema: Dict[str, str]) -> None:
+        """Record a tenant's column->dtype map (captured at profile
+        time): the replay path needs native column types, which saved
+        metrics alone cannot carry."""
+        with self._lock:
+            if self._schemas.get(tenant) != schema:
+                self._schemas[tenant] = dict(schema)
+                self._checkpoint_locked()
+
+    def tenant_schema(self, tenant: str) -> Optional[Dict[str, str]]:
+        with self._lock:
+            schema = self._schemas.get(tenant)
+            return dict(schema) if schema is not None else None
+
+    def _sync_state_gauge_locked(self) -> None:
+        counts = {s: 0 for s in LIFECYCLE_STATES}
+        for c in self._checks.values():
+            counts[c.state] += 1
+        CONTROL_STATS.checks_by_state = counts
+
+    # -- persistence ------------------------------------------------------
+
+    def state_blob(self) -> dict:
+        """JSON-stable state (the kill-and-resume bit-identity
+        observable, like ``QualityMonitor.state_blob``)."""
+        with self._lock:
+            return {
+                "version": STATE_VERSION,
+                "seq": self._seq,
+                "checks": {
+                    cid: c.as_blob()
+                    for cid, c in sorted(self._checks.items())
+                },
+                "events": [e.as_dict() for e in self._events],
+                "schemas": {
+                    t: dict(sorted(s.items()))
+                    for t, s in sorted(self._schemas.items())
+                },
+            }
+
+    def _state_path(self) -> str:
+        return f"{self.state_dir.rstrip('/')}/{STATE_FILE}"
+
+    def _checkpoint_locked(self) -> None:
+        if self._fs is None:
+            return
+        from deequ_tpu.resilience.atomic import (
+            atomic_write_bytes,
+            wrap_checksum,
+        )
+
+        payload = json.dumps(
+            self.state_blob(), separators=(",", ":")
+        ).encode("utf-8")
+        self._fs.makedirs(self.state_dir)
+        atomic_write_bytes(
+            self._fs, self._state_path(), wrap_checksum(payload),
+            what="control-registry state",
+        )
+        CONTROL_STATS.registry_checkpoints += 1
+
+    def checkpoint(self) -> None:
+        """Force a checkpoint now (every mutation already checkpoints)."""
+        with self._lock:
+            self._checkpoint_locked()
+
+    def _load_state(self) -> None:
+        from deequ_tpu.resilience.atomic import read_checksummed
+
+        path = self._state_path()
+        if not self._fs.exists(path):
+            return
+        payload = read_checksummed(self._fs, path, "control-registry state")
+        try:
+            blob = json.loads(payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise CorruptStateException(
+                "control-registry state", f"undecodable payload: {e}"
+            ) from e
+        if blob.get("version", 0) > STATE_VERSION:
+            raise CorruptStateException(
+                "control-registry state",
+                f"version {blob.get('version')} newer than supported "
+                f"{STATE_VERSION}",
+            )
+        self._seq = int(blob.get("seq", 0))
+        self._checks = {
+            cid: RegisteredCheck(**entry)
+            for cid, entry in blob.get("checks", {}).items()
+        }
+        self._events = [
+            _event_from_blob(e) for e in blob.get("events", [])
+        ]
+        self._schemas = {
+            t: dict(s) for t, s in blob.get("schemas", {}).items()
+        }
+        CONTROL_STATS.registry_resumes += 1
+        self._sync_state_gauge_locked()

@@ -295,6 +295,12 @@ MONITOR = register(EnvVar(
     doc="0 disables QualityMonitor observation process-wide (saves and "
         "serving unaffected; alerts stop)",
 ))
+PROMOTE_WINDOWS = register(EnvVar(
+    "DEEQU_TPU_PROMOTE_WINDOWS", "int", default=3, minimum=1,
+    doc="consecutive clean (anomaly-free, shadow-passing) profile "
+        "windows a shadow check must accumulate before the control "
+        "plane promotes it to enforcing (control/promotion.py)",
+))
 TRACE = register(EnvVar(
     "DEEQU_TPU_TRACE", "flag01", default=False,
     doc="1 arms the process-global flight recorder (deequ_tpu/obs)",

@@ -108,6 +108,15 @@ class ServiceClosedException(ServeException):
     """A submit/resume/flush against a stopped VerificationService."""
 
 
+class ControlPlaneException(MetricCalculationRuntimeException):
+    """Typed failure of the closed-loop quality control plane
+    (deequ_tpu/control): an illegal lifecycle transition on the
+    CheckRegistry, a shadow evaluation requested outside the
+    ``best_effort`` SLO class (the isolation invariant — a candidate
+    check must never consume critical capacity), or a profile replay
+    that cannot reconstruct a tenant's history."""
+
+
 class ServiceOverloadedException(ServeException):
     """Typed backpressure: the service refused to buffer this request —
     the pending queue is at ``max_pending``, or (round 15, the admission

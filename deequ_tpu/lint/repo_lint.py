@@ -104,14 +104,26 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # prefix unchanged: admission refusals and deadline sheds MUST stay
     # typed (a bare except around a shed would orphan the future it was
     # about to resolve), so all three disciplines apply in full.
+    # Round 16 widens all three scopes to profiles/, suggestions/, and
+    # the new control/: the profiler now emits its passes through the
+    # serving seam (host-fetch accounting applies to its pass plumbing),
+    # the control plane's registry persists lifecycle state on the same
+    # atomic seams as resilience/ (a swallowed CorruptStateException
+    # would silently double promotion events), and its typed lifecycle /
+    # shed handling must never degrade to untyped raises.
     "host-fetch": (
         "ops/", "parallel/", "anomaly/", "serve/", "obs/", "repository/",
+        "profiles/", "suggestions/", "control/",
     ),
     "bare-except": (
         "ops/", "parallel/", "resilience/", "serve/", "obs/", "repository/",
+        "profiles/", "suggestions/", "control/",
     ),
     "jit-impure": ("",),
-    "typed-raise": ("ops/", "resilience/", "serve/", "obs/", "repository/"),
+    "typed-raise": (
+        "ops/", "resilience/", "serve/", "obs/", "repository/",
+        "profiles/", "suggestions/", "control/",
+    ),
     "span-in-jit": ("",),
     "suppress-reason": ("",),
 }

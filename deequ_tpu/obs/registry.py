@@ -448,12 +448,30 @@ def _kernels_section() -> dict:
     }
 
 
+def _control_section() -> dict:
+    """Read-through over the closed-loop control plane (round 16,
+    deequ_tpu/control): checks per lifecycle state, promotion/demotion
+    event counts, shadow-eval outcomes (passed/failed/shed), and the
+    profile submit/replay traffic. Guarded on ``sys.modules`` like the
+    repository section — a process without a control plane reports
+    ``active: False``, not phantom zeros."""
+    import sys
+
+    out: Dict[str, Any] = {"active": False}
+    control = sys.modules.get("deequ_tpu.control.registry")
+    if control is not None:
+        out["active"] = True
+        out.update(control.CONTROL_STATS.snapshot())
+    return out
+
+
 REGISTRY.register_collector("scan", _scan_section)
 REGISTRY.register_collector("retry", _retry_section)
 REGISTRY.register_collector("hbm", _hbm_section)
 REGISTRY.register_collector("env", _env_section)
 REGISTRY.register_collector("repository", _repository_section)
 REGISTRY.register_collector("kernels", _kernels_section)
+REGISTRY.register_collector("control", _control_section)
 
 
 # -- the serving layer's owned instruments (always-on: one histogram

@@ -4,6 +4,7 @@ from deequ_tpu.profiles.profiler import (
     ColumnProfilerRunner,
     ColumnProfiles,
     NumericColumnProfile,
+    OfflineProfileRuns,
     StandardColumnProfile,
 )
 
@@ -13,5 +14,6 @@ __all__ = [
     "ColumnProfilerRunner",
     "ColumnProfiles",
     "NumericColumnProfile",
+    "OfflineProfileRuns",
     "StandardColumnProfile",
 ]

@@ -10,6 +10,18 @@ doc comment at ColumnProfiler.scala:57-68):
    whose inferred type is numeric (cast first) — one fused scan + KLL pass;
 3. exact histograms for low-cardinality columns (approx distinct <=
    ``low_cardinality_histogram_threshold``, default 120).
+
+Every pass emits its analyzer set through ONE seam — a "runs" object with
+the :class:`OfflineProfileRuns` interface. The default runs each pass as an
+offline ``AnalysisRunner.do_analysis_run`` (the reference shape, repository
+reuse/save included — pass 3 rides the same seam, so saved profiles carry
+their histograms and reuse really reuses them). The control plane
+(``deequ_tpu/control/engine.py``) substitutes a serving-backed runs object
+that submits the SAME analyzer sets through ``VerificationService.submit``
+instead: profile requests then get a PlanKey, coalesce with verification
+traffic, hit the compiled-plan cache on repeat, and obey the one-fetch
+contract — profiling is just another analyzer set (the Flare argument,
+arXiv:1703.08219).
 """
 
 from __future__ import annotations
@@ -129,6 +141,22 @@ _NATIVE_TYPES = {
 }
 
 
+class OfflineProfileRuns:
+    """The profiler's default pass executor: each analyzer set runs as an
+    offline fused ``do_analysis_run`` with the repository kwargs threaded
+    through (reuse + save-or-append work against ANY MetricsRepository —
+    in-memory, fs, or the round-13 columnar backend)."""
+
+    def __init__(self, run_kwargs: Dict):
+        self.run_kwargs = run_kwargs
+
+    def run(self, table, analyzers):
+        """One profiling pass -> AnalyzerContext."""
+        return AnalysisRunner.do_analysis_run(
+            table, analyzers, **self.run_kwargs
+        )
+
+
 class ColumnProfiler:
     @staticmethod
     def profile(
@@ -143,6 +171,7 @@ class ColumnProfiler:
         kll_profiling: bool = False,
         kll_parameters: Optional[KLLParameters] = None,
         predefined_types: Optional[Dict[str, DataTypeInstances]] = None,
+        runs=None,
     ) -> ColumnProfiles:
         predefined_types = predefined_types or {}
         if restrict_to_columns is not None:
@@ -159,6 +188,8 @@ class ColumnProfiler:
             fail_if_results_missing=fail_if_results_for_reusing_missing,
             save_or_append_results_with_key=save_in_metrics_repository_using_key,
         )
+        if runs is None:
+            runs = OfflineProfileRuns(run_kwargs)
 
         # multi-pass workload: keep the table device-resident across passes
         # (the analogue of the reference caching the frequency/grouped data,
@@ -170,6 +201,7 @@ class ColumnProfiler:
             try:
                 data.persist()
                 auto_persisted.append(data)
+            # deequ-lint: ignore[bare-except] -- persistence is an optimization: a device_put OOM/RESOURCE_EXHAUSTED here falls back to streaming, never fails the profile
             except Exception:  # noqa: BLE001 — budget MemoryError, but also
                 # runtime RESOURCE_EXHAUSTED from device_put (fragmentation,
                 # other residents): persistence is an optimization, never a
@@ -180,7 +212,7 @@ class ColumnProfiler:
             return ColumnProfiler._profile_passes(
                 data, relevant, predefined_types, print_status_updates,
                 low_cardinality_histogram_threshold, kll_profiling,
-                kll_parameters, run_kwargs, auto_persisted,
+                kll_parameters, runs, auto_persisted,
             )
         finally:
             for t in auto_persisted:
@@ -190,7 +222,7 @@ class ColumnProfiler:
     def _profile_passes(
         data, relevant, predefined_types, print_status_updates,
         low_cardinality_histogram_threshold, kll_profiling,
-        kll_parameters, run_kwargs, auto_persisted,
+        kll_parameters, runs, auto_persisted,
     ) -> ColumnProfiles:
         # -- pass 1: generic statistics (ColumnProfiler.scala:122-139) ------
         if print_status_updates:
@@ -201,7 +233,7 @@ class ColumnProfiler:
             analyzers.append(ApproxCountDistinct(name))
             if data[name].dtype == DType.STRING and name not in predefined_types:
                 analyzers.append(DataType(name))
-        ctx1 = AnalysisRunner.do_analysis_run(data, analyzers, **run_kwargs)
+        ctx1 = runs.run(data, analyzers)
 
         num_records = int(ctx1.metric_map[Size()].value.get_or_else(0.0))
 
@@ -296,13 +328,10 @@ class ColumnProfiler:
             try:
                 casted.persist()
                 auto_persisted.append(casted)
+            # deequ-lint: ignore[bare-except] -- same persist-is-optional contract as the pass-1 site above
             except Exception:  # noqa: BLE001 — see pass-1 persist comment
                 casted.unpersist()
-        ctx2 = (
-            AnalysisRunner.do_analysis_run(casted, numeric_analyzers, **run_kwargs)
-            if numeric_analyzers
-            else None
-        )
+        ctx2 = runs.run(casted, numeric_analyzers) if numeric_analyzers else None
 
         # -- pass 3: exact histograms for low-cardinality columns -----------
         if print_status_updates:
@@ -319,10 +348,22 @@ class ColumnProfiler:
                 DataTypeInstances.INTEGRAL,
             )
         ]
-        for name in histogram_targets:
-            metric = Histogram(name).calculate(data)
-            if metric.value.is_success:
-                histograms[name] = metric.value.get()
+        if histogram_targets:
+            # pass 3 rides the SAME seam as passes 1-2 (it used to call
+            # ``Histogram(name).calculate(data)`` per column, bypassing
+            # the repository entirely): saved profiles now carry their
+            # histogram metrics — so a repository replay can reconstruct
+            # categorical profiles — and reuse keys really reuse them.
+            # Per-column grouped passes inside one run produce metrics
+            # bit-identical to the standalone calculate (pinned by the
+            # tier-1 ctrl suite).
+            ctx3 = runs.run(
+                data, [Histogram(name) for name in histogram_targets]
+            )
+            for name in histogram_targets:
+                metric = ctx3.metric_map.get(Histogram(name))
+                if metric is not None and metric.value.is_success:
+                    histograms[name] = metric.value.get()
 
         # -- assemble -------------------------------------------------------
         profiles: Dict[str, ColumnProfile] = {}
@@ -388,6 +429,7 @@ class ColumnProfilerRunBuilder:
         self._kll_profiling = False
         self._kll_parameters: Optional[KLLParameters] = None
         self._predefined_types: Dict[str, DataTypeInstances] = {}
+        self._runs = None
 
     def restrict_to_columns(self, columns: Sequence[str]):
         self._restrict_to_columns = columns
@@ -426,6 +468,14 @@ class ColumnProfilerRunBuilder:
         self._save_key = key
         return self
 
+    def with_runs(self, runs):
+        """Run every profiling pass through ``runs`` (an object with the
+        :class:`OfflineProfileRuns` interface) instead of the offline
+        fused scans — e.g. the control plane's serving-backed executor
+        (``deequ_tpu.control.ServeProfileRuns``)."""
+        self._runs = runs
+        return self
+
     def run(self) -> ColumnProfiles:
         return ColumnProfiler.profile(
             self._data,
@@ -439,4 +489,5 @@ class ColumnProfilerRunBuilder:
             kll_profiling=self._kll_profiling,
             kll_parameters=self._kll_parameters,
             predefined_types=self._predefined_types,
+            runs=self._runs,
         )

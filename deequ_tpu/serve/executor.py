@@ -380,13 +380,26 @@ def run_coalesced(
     # a cache-hit batch (plan + program + lint verdict all memoized)
     # performs ZERO lint traces — the repeat-tenant contract
     if plan_lint != "off":
+        # the LUTs must enter the lint trace as ARGUMENTS (abstract),
+        # exactly like the build trace above passes them: closing over
+        # the concrete host arrays routes encoded-column ingest through
+        # numpy fancy indexing on a traced codes buffer, which raises
+        # TracerArrayConversionError for any plan with encoded columns
+        # (e.g. ApproxCountDistinct/DataType on strings — the profile
+        # pass-1 shape)
+        lut_items = sorted(lut_host.items())
+        lut_keys = tuple(k for k, _ in lut_items)
+        n_bufs = len(bufs)
         avals = tuple(
             jax.ShapeDtypeStruct(b.shape[1:], b.dtype) for b in bufs
+        ) + tuple(
+            jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+            for _, v in lut_items
         )
         findings, traced = lint_plan_cached(
             plan_ir,
             lambda *a: single_flat(
-                *a, {k: v[0] for k, v in lut_host.items()}
+                *a[:n_bufs], dict(zip(lut_keys, a[n_bufs:]))
             ),
             avals,
             packed_lint_memo_key(plan, k_bucket, lut_sig, members),
