@@ -278,6 +278,27 @@ FAILOVER_RETRIES = register(EnvVar(
     doc="max worker-loss re-dispatches one accepted request may ride "
         "before it rejects typed (WorkerLostException)",
 ))
+FLEET_TRANSPORT = register(EnvVar(
+    "DEEQU_TPU_FLEET_TRANSPORT", "choice", default="proc",
+    choices=("proc", "loopback"),
+    doc="ProcessFleet worker isolation (serve/pfleet.py, PR 17): 'proc' "
+        "spawns one worker PROCESS per member over socketpair frame "
+        "transport; 'loopback' runs the identical protocol loop in "
+        "threads (deterministic tests, single-process deployments)",
+))
+FLEET_LEDGER_DIR = register(EnvVar(
+    "DEEQU_TPU_FLEET_LEDGER_DIR", "str", default=None,
+    doc="directory for the fleet's durable checksummed request ledger "
+        "(serve/ledger.py): accepted work persists at accept time and "
+        "a killed coordinator resumes from it (unset = in-RAM only, "
+        "the pre-PR-17 durability)",
+))
+COORD_RESUME = register(EnvVar(
+    "DEEQU_TPU_COORD_RESUME", "flag01", default=True,
+    doc="0 disables replaying outstanding request-ledger records when a "
+        "fleet opens over a ledger_dir that already holds them "
+        "(forensics mode: the ledger is read but nothing re-dispatches)",
+))
 REPO_SEGMENT_ROWS = register(EnvVar(
     "DEEQU_TPU_REPO_SEGMENT_ROWS", "int", default=4096, minimum=1,
     doc="target scalar-metric rows per compacted columnar-repository "

@@ -553,6 +553,33 @@ FLEET_WORKERS_ALIVE = REGISTRY.gauge(
 )
 
 
+# -- the process-fleet tier's owned instruments (serve/pfleet.py +
+#    serve/ledger.py, PR 17; the "pfleet" collector section —
+#    per-worker-process liveness + inflight + ledger state — is
+#    registered by the pfleet module itself) ----------------------------------
+
+LEDGER_APPENDS = REGISTRY.counter(
+    "fleet_ledger_appends",
+    "durable request-ledger frames appended (serve/ledger.py: one per "
+    "accept, one tombstone per resolve — each fsynced before the "
+    "submit/resolution proceeds)",
+)
+PFLEET_WORKERS_ALIVE = REGISTRY.gauge(
+    "pfleet_workers_alive",
+    "alive worker PROCESSES of the active ProcessFleet",
+)
+PFLEET_REDISPATCHES = REGISTRY.counter(
+    "pfleet_redispatches",
+    "accepted requests re-sent to a surviving worker process after the "
+    "placed worker's process died (SIGKILL included)",
+)
+PFLEET_RESUMED = REGISTRY.counter(
+    "pfleet_resumed",
+    "outstanding ledger records a resuming coordinator replayed "
+    "(coordinator kill-and-resume, serve/pfleet.py)",
+)
+
+
 def _serve_section() -> dict:
     from deequ_tpu.ops.scan_engine import SCAN_STATS
 

@@ -104,15 +104,46 @@ def probe_liveness(
     This is the ``check_peers`` probe seam factored out so OTHER
     membership tiers can ride it — the serving fleet's worker heartbeat
     (``serve/membership.py``) injects a thread-liveness probe here
-    exactly the way tests inject deterministic peer probes. The contract
-    is the probe's: ``probe(timeout)`` returns the responsive member
-    ids; a ``TimeoutError`` means the stall could not be attributed and
-    propagates for the caller to convert into its typed loss exception
-    (every member suspect)."""
+    exactly the way tests inject deterministic peer probes, and the
+    process fleet (``serve/pfleet.py``) injects a transport ping probe.
+    The contract is the probe's: ``probe(timeout)`` returns the
+    responsive member ids; a ``TimeoutError`` means the stall could not
+    be attributed and propagates for the caller to convert into its
+    typed loss exception (every member suspect)."""
     alive = sorted(int(p) for p in probe(timeout))
     expected_set = {int(i) for i in expected}
     lost = sorted(expected_set - set(alive))
     return [p for p in alive if p in expected_set], lost
+
+
+def validate_loss_mode(value: str, param: str) -> None:
+    """Shared argument validation for every liveness-check tier: the
+    only loss policies are ``"fail"`` (raise typed) and ``"degrade"``
+    (return a report for the caller's failover/partial-result path)."""
+    if value not in ("fail", "degrade"):
+        raise ValueError(
+            f"{param} must be 'fail' or 'degrade', got {value!r}"
+        )
+
+
+def run_liveness_check(
+    expected: Sequence[int],
+    timeout: float,
+    probe: Callable[[float], Sequence[int]],
+    unattributable: Callable[[TimeoutError], BaseException],
+) -> Tuple[List[int], List[int]]:
+    """The shared core of every membership check — ``check_peers``
+    (multi-host scan), ``FleetMembership.check_workers`` (in-process
+    fleet), and the process fleet's transport membership all call THIS,
+    so the three tiers cannot drift: run the injected probe, attribute
+    losses, and convert an unattributable ``TimeoutError`` into the
+    caller's typed loss exception (every member suspect — even a
+    "degrade" caller cannot pick a failover target without
+    attribution, so the typed raise is unconditional)."""
+    try:
+        return probe_liveness(expected, timeout, probe)
+    except TimeoutError as e:
+        raise unattributable(e) from e
 
 
 @dataclass
@@ -227,26 +258,22 @@ def check_peers(
       and the omission is REPORTED (``ScanStats.record_unverified`` →
       ``VerificationResult.unverified_row_ranges``), never silent.
     """
-    if on_peer_loss not in ("fail", "degrade"):
-        raise ValueError(
-            f"on_peer_loss must be 'fail' or 'degrade', "
-            f"got {on_peer_loss!r}"
-        )
+    validate_loss_mode(on_peer_loss, "on_peer_loss")
     n_proc = jax.process_count()
     report = PeerLossReport(n_processes=n_proc)
     if n_proc <= 1:
         report.surviving = list(range(n_proc))
         return report
     probe = probe or _default_peer_probe
-    try:
-        alive, lost = probe_liveness(range(n_proc), timeout, probe)
-    except TimeoutError as e:
-        # unattributable stall: degrading would silently drop unknown
-        # rows, so even "degrade" raises typed here
-        raise PeerLostException(
+    # unattributable stall: degrading would silently drop unknown
+    # rows, so even "degrade" raises typed (run_liveness_check rule)
+    alive, lost = run_liveness_check(
+        range(n_proc), timeout, probe,
+        lambda e: PeerLostException(
             f"multi-host barrier timed out after {timeout:g}s and the "
             f"stall could not be attributed to specific peers: {e}",
-        ) from e
+        ),
+    )
     report.surviving = alive
     report.lost = lost
     if not lost:

@@ -22,7 +22,14 @@ existing injector seam into one timeline —
   :class:`~deequ_tpu.serve.fleet.VerificationFleet`. A schedule with
   any worker event runs the FLEET scenario instead of the streaming
   one: the same batch partition becomes per-tenant suites submitted in
-  waves to a 4-worker fleet, with the events applied between waves;
+  waves to a 4-worker fleet, with the events applied between waves.
+  Two PROCESS-fleet kinds ride the same seam (round 17): ``kill9``
+  (a REAL ``kill -9`` on a worker process of a ledger-backed
+  :class:`~deequ_tpu.serve.pfleet.ProcessFleet` — loss surfaces as
+  transport EOF, failover must re-dispatch bit-identically) and
+  ``coord_kill9`` (the COORDINATOR dies mid-wave and a fresh one
+  resumes off the durable request ledger, onto the original futures).
+  Any schedule with those kinds runs the process-fleet scenario;
 - ``load``  — overload faults (round 15, the admission tier): scripted
   OPEN-LOOP SPIKES (a flood tenant bursts tight-deadline best_effort
   submissions mid-wave, no pacing) and SLOW-TENANT stalls (the worker
@@ -134,6 +141,15 @@ FLEET_N_WORKERS = 4
 FLEET_WAVES = 3
 FLEET_TENANT_ROWS = (250, 350, 450, 550)  # sums to N_ROWS
 _WORKER_KINDS = ("death", "stall", "rejoin")
+
+#: process-fleet scenario (round 17, kill -9 seam): fewer waves than
+#: the in-process fleet — every worker is a real spawned process
+#: (fork + import + per-process compiles), so each wave costs real
+#: wall-clock; the scripted kills are the expensive part being tested
+PFLEET_WAVES = 2
+#: worker-seam kinds that select the PROCESS-fleet scenario
+_PWORKER_KINDS = ("kill9", "rejoin", "coord_kill9")
+_PWORKER_ONLY_KINDS = ("kill9", "coord_kill9")
 
 #: fleet membership knobs for the scenario: a heartbeat probe every
 #: 50ms, a worker declared lost after 0.3s of silence
@@ -354,6 +370,62 @@ class ChaosSchedule:
         # compiles (4 distinct tenant shapes) before steady state
         return ChaosSchedule(
             seed=seed, events=tuple(events), run_deadline=30.0,
+        )
+
+    @staticmethod
+    def generate_pworker(seed: int) -> "ChaosSchedule":
+        """Seeded PROCESS-fleet schedule (the kill -9 seam): scripted
+        ``kill9`` (real SIGKILL on a worker process), ``rejoin``, and
+        at most one ``coord_kill9`` (coordinator death + ledger-backed
+        resume) over the waves. Same survivor discipline as
+        :meth:`generate_worker` — every schedule must leave somewhere
+        to fail over TO. A ``coord_kill9`` resets the down-set: the
+        resumed coordinator spawns a full fresh fleet."""
+        rng = Random(seed)
+        events: List[dict] = []
+        down: set = set()
+        used_coord = False
+        for wave in range(PFLEET_WAVES):
+            if events and rng.random() < 0.5:
+                continue
+            up = [w for w in range(FLEET_N_WORKERS) if w not in down]
+            kinds: List[str] = []
+            if len(up) > 1:
+                kinds += ["kill9", "kill9"]
+            if down:
+                kinds += ["rejoin"]
+            if not used_coord:
+                kinds += ["coord_kill9"]
+            if not kinds:
+                continue
+            kind = rng.choice(kinds)
+            if kind == "coord_kill9":
+                used_coord = True
+                down = set()
+                events.append(
+                    {"seam": "worker", "kind": "coord_kill9",
+                     "wave": wave}
+                )
+                continue
+            if kind == "rejoin":
+                worker = rng.choice(sorted(down))
+                down.discard(worker)
+            else:
+                worker = rng.choice(up)
+                down.add(worker)
+            events.append(
+                {"seam": "worker", "kind": kind, "worker": worker,
+                 "wave": wave}
+            )
+        if not events:
+            events.append(
+                {"seam": "worker", "kind": "kill9",
+                 "worker": rng.randrange(FLEET_N_WORKERS),
+                 "wave": PFLEET_WAVES - 1}
+            )
+        # process spawns + per-process compiles dominate the wall clock
+        return ChaosSchedule(
+            seed=seed, events=tuple(events), run_deadline=90.0,
         )
 
     @staticmethod
@@ -625,6 +697,14 @@ def run_schedule(
     regression."""
     if any(e.get("seam") == "load" for e in schedule.events):
         return _run_load_schedule(schedule, simulate_drift=simulate_drift)
+    if any(
+        e.get("seam") == "worker"
+        and e.get("kind") in _PWORKER_ONLY_KINDS
+        for e in schedule.events
+    ):
+        return _run_pworker_schedule(
+            schedule, simulate_drift=simulate_drift
+        )
     if any(e.get("seam") == "worker" for e in schedule.events):
         return _run_worker_schedule(schedule, simulate_drift=simulate_drift)
     from deequ_tpu.data.source import TableBatchSource
@@ -1060,6 +1140,221 @@ def _check_worker_oracles(
                 f"{exp[1]!r} (failover must be bit-identical)"
             )
     return v
+
+
+# -- the process-fleet scenario (kill -9 seam, round 17) ---------------------
+
+
+def _apply_pworker_event(state: dict, event: dict, resume_map) -> None:
+    """One scripted process-fleet event, while its wave is in flight.
+    ``kill9`` is a REAL SIGKILL on the worker process (the loss signal
+    is transport EOF, exactly like host death); ``coord_kill9``
+    abandons the coordinator object wholesale — what SIGKILL does to
+    its threads, sockets, and ledger handle — and resumes a FRESH
+    :class:`~deequ_tpu.serve.pfleet.ProcessFleet` off the durable
+    ledger, onto the original futures (``resume_map``)."""
+    from deequ_tpu.serve.pfleet import ProcessFleet
+
+    kind = event["kind"]
+    fleet = state["fleet"]
+    if kind == "kill9":
+        fleet.kill_worker(int(event["worker"]), reason="chaos kill -9")
+    elif kind == "rejoin":
+        fleet.rejoin_worker(int(event["worker"]))
+    elif kind == "coord_kill9":
+        # the old incarnation's loss counters must survive the swap —
+        # the report accounts for the whole timeline, not one fleet
+        state["workers_lost"] += fleet.workers_lost
+        state["redispatched"] += fleet.requests_redispatched
+        fleet.abandon()
+        state["fleet"] = ProcessFleet(
+            n_workers=FLEET_N_WORKERS,
+            transport=state["transport"],
+            ledger_dir=state["ledger_dir"],
+            heartbeat_interval=FLEET_HEARTBEAT,
+            stall_timeout=FLEET_STALL_TIMEOUT,
+            monitor=False,
+            resume_futures=resume_map(),
+        )
+        state["resumed"] += len(state["fleet"].resumed)
+    else:
+        raise ValueError(f"unknown pworker event kind {kind!r}")
+
+
+def _run_pworker_schedule(
+    schedule: ChaosSchedule, simulate_drift: bool = False
+) -> ChaosReport:
+    """The PROCESS-fleet scenario (kill -9 seam): ``PFLEET_WAVES``
+    waves of per-tenant suites over a ledger-backed
+    :class:`~deequ_tpu.serve.pfleet.ProcessFleet` of REAL worker
+    processes. ``kill9`` events SIGKILL a worker mid-wave — failover
+    must re-dispatch its in-flight tenants bit-identically onto
+    survivors; a ``coord_kill9`` kills the COORDINATOR mid-wave and
+    resumes a fresh one off the durable request ledger, onto the
+    original futures. Oracle 8 (exactly-once) then holds across BOTH
+    process boundaries: no future orphaned by a dead worker OR a dead
+    coordinator, none double-resolved by the ledger replay (the
+    first-resolution-wins gate)."""
+    import shutil
+    import tempfile
+
+    from deequ_tpu.obs.registry import REGISTRY
+    from deequ_tpu.serve.pfleet import ProcessFleet
+
+    table = _build_table()
+    tenants = _tenant_slices(table)
+    ref = {t: _fleet_reference(t, tbl) for t, tbl in enumerate(tenants)}
+
+    by_wave: Dict[int, List[dict]] = {}
+    for e in schedule.events:
+        if e.get("seam") == "worker":
+            by_wave.setdefault(int(e.get("wave", 0)), []).append(e)
+
+    applied: List[tuple] = []
+    gathered: List[tuple] = []  # (wave, tenant, future)
+    all_futures: List = []
+    exc: Optional[BaseException] = None
+    ledger_dir = tempfile.mkdtemp(prefix="deequ-chaos-ledger-")
+    state = {
+        "fleet": None,
+        "ledger_dir": ledger_dir,
+        "transport": "proc",
+        "workers_lost": 0,
+        "redispatched": 0,
+        "resumed": 0,
+    }
+
+    def resume_map():
+        # the driver survived the coordinator: resume onto the
+        # ORIGINAL futures. Ids missing here (resolved in the race
+        # window before the kill) are already tombstoned — the replay
+        # skips them entirely
+        return {
+            f.accept_id: f for f in all_futures
+            if not f.done() and getattr(f, "accept_id", None)
+        }
+
+    reg_before = REGISTRY.snapshot()
+    t0 = time.monotonic()
+    # monitor off: SIGKILL loss surfaces as transport EOF through the
+    # receiver thread, which is immediate and deterministic — the
+    # membership monitor's probe cadence would only add replay jitter
+    state["fleet"] = ProcessFleet(
+        n_workers=FLEET_N_WORKERS,
+        transport="proc",
+        ledger_dir=ledger_dir,
+        heartbeat_interval=FLEET_HEARTBEAT,
+        stall_timeout=FLEET_STALL_TIMEOUT,
+        monitor=False,
+    )
+    try:
+        # warmup wave: every worker process compiles its placed tenant
+        # shapes before any scripted kill, then prewarm ships the hot
+        # fingerprints fleet-wide so failover lands on warm survivors
+        warmup = [
+            state["fleet"].submit(
+                tbl, [_check()],
+                required_analyzers=_analyzers(), tenant=f"t{t}",
+            )
+            for t, tbl in enumerate(tenants)
+        ]
+        for future in warmup:
+            future.result(timeout=schedule.run_deadline)
+        state["fleet"].prewarm()
+        for wave in range(PFLEET_WAVES):
+            wave_futures = []
+            for t, tbl in enumerate(tenants):
+                future = state["fleet"].submit(
+                    tbl, [_check()],
+                    required_analyzers=_analyzers(), tenant=f"t{t}",
+                )
+                wave_futures.append((t, future))
+                all_futures.append(future)
+            # the wave is in flight: apply this wave's scripted events
+            for e in by_wave.get(wave, ()):
+                _apply_pworker_event(state, e, resume_map)
+                applied.append(
+                    ("worker", e["kind"], int(e.get("worker", -1)), wave)
+                )
+            for t, future in wave_futures:
+                gathered.append((wave, t, future))
+                try:
+                    future.result(timeout=schedule.run_deadline)
+                # deequ-lint: ignore[bare-except] -- the chaos driver observes ANY per-future outcome; oracle 1 re-checks that it was typed
+                except Exception:  # noqa: BLE001
+                    pass
+    # deequ-lint: ignore[bare-except] -- a submit on an all-dead fleet (or any driver error) becomes the report's outcome; oracle 1 checks it is typed
+    except Exception as e:  # noqa: BLE001
+        exc = e
+    finally:
+        try:
+            state["fleet"].stop(drain=True)
+        finally:
+            shutil.rmtree(ledger_dir, ignore_errors=True)
+    elapsed = time.monotonic() - t0
+    reg_after = REGISTRY.snapshot()
+
+    metrics: Dict[str, tuple] = {}
+    for wave, t, future in gathered:
+        prefix = f"w{wave}/t{t}"
+        if future._error is not None:
+            metrics[prefix] = ("fail", type(future._error).__name__)
+        elif future._result is not None:
+            for name, row in _metric_rows(future._result).items():
+                metrics[f"{prefix}/{name}"] = row
+    rejected = sum(
+        1 for _, _, f in gathered if f.done() and f._error is not None
+    )
+    # scan deltas are coordinator-side only (the worker processes keep
+    # their own registries): both stay 0 here, so the fetch-contract
+    # oracle holds trivially — cross-process fetch accounting is the
+    # worker tests' job, not the chaos driver's
+    scan_before, scan_after = reg_before["scan"], reg_after["scan"]
+    final = state["fleet"]
+    report = ChaosReport(
+        schedule=schedule,
+        outcome=(
+            f"exception:{type(exc).__name__}" if exc is not None
+            else ("degraded" if rejected else "identical")
+        ),
+        elapsed=elapsed,
+        metrics=metrics,
+        scan_delta={
+            k: scan_after[k] - scan_before[k]
+            for k in ("scan_passes", "device_fetches")
+        },
+        injected=applied,
+        fleet={
+            "accepted": len(gathered),
+            "resolved_once": sum(
+                1 for _, _, f in gathered
+                if f.done() and f.resolve_count == 1
+            ),
+            "orphaned": sum(1 for _, _, f in gathered if not f.done()),
+            "multi_resolved": sum(
+                1 for _, _, f in gathered if f.resolve_count > 1
+            ),
+            "late_resolutions": sum(
+                f.late_resolutions for _, _, f in gathered
+            ),
+            "rejected": rejected,
+            "workers_lost": state["workers_lost"] + final.workers_lost,
+            "requests_redispatched": (
+                state["redispatched"] + final.requests_redispatched
+            ),
+            "resumed": state["resumed"],
+        },
+    )
+
+    if simulate_drift and applied and report.metrics:
+        report.drifted = True
+        report.metrics = {
+            k: ("ok", v + 1e-9) if status == "ok" else (status, v)
+            for k, (status, v) in report.metrics.items()
+        }
+
+    report.violations = _check_worker_oracles(report, ref, exc)
+    return report
 
 
 # -- the load scenario (overload seam, round 15) -----------------------------

@@ -38,6 +38,13 @@ plan/executor split another notch):
   shed typed pre-dispatch), and the 3-level brownout ladder — overload
   changes WHICH requests run, never how (completed results stay
   bit-identical to an unloaded serial run).
+- :mod:`pfleet` (+ :mod:`transport`, :mod:`ledger`, :mod:`pworker`) —
+  the PROCESS fleet (round 17): coordinator + N worker processes
+  behind a checksummed frame transport, plan warmup via shipped
+  fingerprints (the joiner mints the service's own ``PlanKey``), typed
+  backpressure reconstructed from wire fields, a durable accept-time
+  request ledger with torn-tail recovery, real-SIGKILL worker
+  failover, and coordinator kill-and-resume onto original futures.
 
 See docs/serving.md for cache-key semantics, coalescing/padding rules,
 and the isolation ladder.
@@ -50,7 +57,9 @@ from deequ_tpu.serve.admission import (
     TenantFairQueue,
 )
 from deequ_tpu.serve.fleet import FleetConfig, VerificationFleet
+from deequ_tpu.serve.ledger import RequestLedger
 from deequ_tpu.serve.membership import FleetMembership, WorkerLossReport
+from deequ_tpu.serve.pfleet import ProcessFleet, ProcessFleetConfig
 from deequ_tpu.serve.plan_cache import PlanCache, PlanKey, ServePlan
 from deequ_tpu.serve.router import ConsistentHashRouter, route_digest
 from deequ_tpu.serve.service import (
@@ -69,6 +78,9 @@ __all__ = [
     "PendingWork",
     "PlanCache",
     "PlanKey",
+    "ProcessFleet",
+    "ProcessFleetConfig",
+    "RequestLedger",
     "route_digest",
     "ServePlan",
     "ServeConfig",

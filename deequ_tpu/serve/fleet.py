@@ -47,23 +47,26 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 import weakref
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
 from deequ_tpu.exceptions import (
+    CorruptStateException,
     DeadlineExceededException,
     RunBudgetExhaustedException,
     ServiceClosedException,
     ServiceOverloadedException,
     WorkerLostException,
 )
-from deequ_tpu.serve.admission import resolve_slo
+from deequ_tpu.serve.admission import Slo, resolve_slo
 from deequ_tpu.serve.membership import FleetMembership
 from deequ_tpu.serve.router import ConsistentHashRouter, route_digest
 from deequ_tpu.serve.service import (
     ServeConfig,
     ServeRequest,
+    VerificationFuture,
     VerificationService,
     _TenantHealth,
 )
@@ -114,6 +117,16 @@ class FleetConfig:
     quarantine_after: int = 2
     run_policy: Any = None
     worker_knobs: Optional[Dict[str, Any]] = None
+    #: durable request ledger (PR 17, serve/ledger.py): when set, every
+    #: fleet acceptance fsyncs a checksummed frame before its future is
+    #: returned and every resolution appends a tombstone, so even the
+    #: IN-PROCESS fleet recovers orphaned futures after a coordinator
+    #: crash — pass the same dir (plus ``resume_futures``) to a fresh
+    #: fleet and it replays accepted-minus-tombstoned onto the original
+    #: futures. One fleet per ledger dir. Defaults from
+    #: DEEQU_TPU_FLEET_LEDGER_DIR (None = no durability).
+    ledger_dir: Optional[str] = None
+    ledger_mode: str = "recover"
     #: True (production shape) pins worker i to device i — fleet
     #: parallelism across chips, but a failover target pays one
     #: per-device compile for each migrated plan (jit executables are
@@ -150,6 +163,8 @@ class FleetConfig:
         if self.warm_plans < 0:
             raise ValueError("warm_plans must be >= 0")
         self.worker_knobs = dict(self.worker_knobs or {})
+        if self.ledger_dir is None:
+            self.ledger_dir = env_value("DEEQU_TPU_FLEET_LEDGER_DIR")
 
 
 class FleetWorker:
@@ -190,6 +205,9 @@ class _Assignment:
     #: original future instead of replayed stale (round 15)
     slo: Any = None
     deadline_at: Optional[float] = None
+    #: this acceptance's durable-ledger frame id (None when the fleet
+    #: runs without a ledger_dir)
+    accept_id: Optional[str] = None
 
 
 #: the most recent fleet, for the obs registry's read-through section
@@ -212,7 +230,9 @@ class VerificationFleet:
     """The multi-worker serving entry point (see module doc)."""
 
     def __init__(self, config: Optional[FleetConfig] = None,
-                 start: bool = True, trace=None, **knobs):
+                 start: bool = True, trace=None,
+                 resume_futures: Optional[Dict[str, Any]] = None,
+                 **knobs):
         global _ACTIVE_FLEET
         import jax
 
@@ -244,6 +264,18 @@ class VerificationFleet:
         self._closed = False
         self.workers_lost = 0
         self.requests_redispatched = 0
+        #: durable acceptance record (FleetConfig.ledger_dir): frames
+        #: fsync at accept, tombstone at resolve — crash recovery for
+        #: the in-process fleet too
+        self._ledger = None
+        #: accept_id -> future for ledger records replayed at startup
+        self.resumed: Dict[str, Any] = {}
+        if self.config.ledger_dir:
+            from deequ_tpu.serve.ledger import RequestLedger
+
+            self._ledger = RequestLedger(
+                self.config.ledger_dir, mode=self.config.ledger_mode
+            )
         self.membership = FleetMembership(
             members=self._alive_ids,
             probe_of=self._probe_worker,
@@ -262,6 +294,7 @@ class VerificationFleet:
 
         REGISTRY.register_collector("fleet", _fleet_section)
         self._update_alive_gauge()
+        self._replay_ledger(resume_futures or {})
         if start and self.config.monitor:
             self.membership.start()
 
@@ -490,6 +523,26 @@ class VerificationFleet:
             )
             with self._lock:
                 self._assignments[future] = asg
+            if self._ledger is not None:
+                # accept-time durability: the frame fsyncs BEFORE the
+                # caller ever holds the future, so a coordinator crash
+                # at any later instant can still replay this request
+                asg.accept_id = uuid.uuid4().hex
+                future.accept_id = asg.accept_id
+                self._ledger.append_accept(
+                    asg.accept_id,
+                    tenant=tenant,
+                    digest=digest,
+                    slo_cls=slo.cls,
+                    deadline_ms=slo.deadline_ms,
+                    weight=slo.weight,
+                    deadline_left_s=(
+                        asg.deadline_at - time.monotonic()
+                        if asg.deadline_at is not None else None
+                    ),
+                    work=(data, tuple(checks), tuple(required_analyzers)),
+                    quarantine=self._tenant_health.snapshot(),
+                )
         self._chain_done(future)
         return future
 
@@ -500,21 +553,33 @@ class VerificationFleet:
     def _chain_done(self, future) -> None:
         """Wrap the service's observation seam so the fleet ledger drops
         the assignment the moment its future resolves (the service's
-        own histogram/trace callback still runs first)."""
+        own histogram/trace callback still runs first) — and, when the
+        durable ledger is on, appends the resolve tombstone."""
         prev = future._on_done
 
         def _done(f, ok, _prev=prev):
             if _prev is not None:
                 _prev(f, ok)
-            with self._lock:
-                self._assignments.pop(f, None)
+            self._drop_assignment(f)
 
         future._on_done = _done
         if future.done():
             # resolved between submit and chaining: the callback already
             # fired on the unwrapped seam — clean the ledger directly
-            with self._lock:
-                self._assignments.pop(future, None)
+            self._drop_assignment(future)
+
+    def _drop_assignment(self, future) -> None:
+        with self._lock:
+            popped = self._assignments.pop(future, None)
+        if (popped is not None and popped.accept_id is not None
+                and self._ledger is not None):
+            try:
+                self._ledger.append_resolve(popped.accept_id)
+            except (OSError, ValueError):
+                # a tombstone lost to a closing ledger costs one
+                # redundant (first-resolution-gated) replay at resume,
+                # never a lost result
+                pass
 
     # -- failover --------------------------------------------------------
 
@@ -715,6 +780,125 @@ class VerificationFleet:
         result.run_budget = asg.budget.snapshot()
         future._resolve(result)
 
+    # -- coordinator resume ----------------------------------------------
+
+    def _replay_ledger(self, resume_futures: Dict[str, Any]) -> None:
+        """Kill-and-resume for the IN-PROCESS fleet: replay every
+        accepted-but-untombstoned ledger record (the futures a crashed
+        coordinator orphaned) through the workers' ``resume`` seam —
+        original futures where the driver survived, fresh ones
+        otherwise. Deadlines resume minus the wall-clock spent dead;
+        expired victims shed typed instead of replaying stale."""
+        if self._ledger is None:
+            return
+        outstanding = self._ledger.outstanding()
+        if not outstanding:
+            return
+        from deequ_tpu.envcfg import env_value
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+        from deequ_tpu.serve.ledger import RequestLedger
+
+        if not env_value("DEEQU_TPU_COORD_RESUME"):
+            SCAN_STATS.record_degradation(
+                "coord_resume_disabled", outstanding=len(outstanding),
+            )
+            return
+        snap = self._ledger.latest_quarantine()
+        if snap is not None:
+            self._tenant_health.restore(snap)
+        now_wall = time.time()
+        with self._failover_lock:
+            for accept_id, rec in outstanding.items():
+                try:
+                    tenant = RequestLedger.load_tenant(rec)
+                    data, checks, required = RequestLedger.load_work(rec)
+                except CorruptStateException as e:
+                    SCAN_STATS.record_degradation(
+                        "ledger_undecodable_record", id=accept_id,
+                        error=str(e),
+                    )
+                    continue
+                future = resume_futures.get(accept_id)
+                if future is None:
+                    future = VerificationFuture(tenant)
+                future.accept_id = accept_id
+                slo_rec = rec.get("slo") or {}
+                slo = Slo(
+                    deadline_ms=slo_rec.get("deadline_ms"),
+                    weight=float(slo_rec.get("weight", 1.0)),
+                    cls=str(slo_rec.get("cls", "standard")),
+                )
+                left = None
+                if rec.get("deadline_left_s") is not None:
+                    dead_for = now_wall - float(
+                        rec.get("accepted_wall", now_wall)
+                    )
+                    left = float(rec["deadline_left_s"]) - max(
+                        dead_for, 0.0
+                    )
+                analyzers = list(required)
+                for check in checks:
+                    analyzers.extend(check.required_analyzers())
+                digest = rec.get("digest") or route_digest(data, analyzers)
+                asg = _Assignment(
+                    data=data,
+                    checks=tuple(checks),
+                    required_analyzers=tuple(required),
+                    tenant=tenant,
+                    budget=None,
+                    digest=digest,
+                    worker=-1,
+                    slo=slo,
+                    deadline_at=(
+                        time.monotonic() + left
+                        if left is not None else None
+                    ),
+                    accept_id=accept_id,
+                )
+                with self._lock:
+                    self._assignments[future] = asg
+                    self._record_heat(digest)
+                self._chain_done(future)
+                self.resumed[accept_id] = future
+                if left is not None and left <= 0:
+                    self._shed_expired_victim(future, asg, -1)
+                    continue
+                with self._lock:
+                    wid = self._router.place(digest)
+                    target = (
+                        self._workers.get(wid) if wid is not None else None
+                    )
+                if target is None:
+                    future._reject(WorkerLostException(
+                        "resume replay found no alive workers",
+                        worker_ids=(),
+                    ))
+                    continue
+                req = ServeRequest(
+                    data=data,
+                    checks=tuple(checks),
+                    required_analyzers=tuple(required),
+                    tenant=tenant,
+                    run_policy=None,
+                    future=future,
+                    slo=slo,
+                    deadline_at=asg.deadline_at,
+                )
+                asg.worker = target.idx
+                try:
+                    target.service.resume([req])
+                except ServiceClosedException as e:
+                    future._reject(WorkerLostException(
+                        f"resume replay target worker {target.idx} "
+                        f"already closed: {e}",
+                        worker_ids=(target.idx,),
+                    ))
+                    continue
+                self._chain_done(future)  # resume() rebound the seam
+        SCAN_STATS.record_degradation(
+            "coord_resume", replayed=len(self.resumed),
+        )
+
     # -- lifecycle -------------------------------------------------------
 
     def flush(self, timeout: Optional[float] = None) -> None:
@@ -740,6 +924,8 @@ class VerificationFleet:
             service.stop(drain=drain)
         for zombie in zombies:
             zombie.stop(drain=False, join=False)
+        if self._ledger is not None:
+            self._ledger.close()
         self._update_alive_gauge(0)
         with self._lock:
             leftovers = [

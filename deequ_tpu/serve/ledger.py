@@ -1,0 +1,222 @@
+"""Durable request ledger — the fleet's accepted-work record, persisted
+at ACCEPT time through the checksummed frame format.
+
+ROADMAP item 1's durability hole: the fleet's assignment ledger and the
+futures it resolves live in coordinator RAM, so host death orphans
+every accepted request — ``PendingWork`` only covers a graceful
+``stop(drain=False)``. This module closes it. Every acceptance appends
+one checksummed frame (:func:`deequ_tpu.serve.transport.encode_frame` —
+the wire envelope and the durable envelope are the SAME bytes) to an
+append-only file, fsynced before the submit returns its future; every
+resolution appends a tombstone. Recovery replays the file: accepted
+minus tombstoned is exactly the work a dead coordinator still owed, and
+a fresh coordinator re-dispatches it (onto the original futures when
+the driver survived, fresh ones when it did not — the future's
+first-resolution-wins gate keeps exactly-once either way).
+
+Torn-write recovery mirrors the metrics repository's torn-SEGMENT
+semantics (repository/columnar.py) at frame granularity: a record that
+tears mid-append (crash between ``write`` and a complete frame) makes
+the file's TAIL unreadable, never its head. ``mode="recover"`` (the
+coordinator-resume default) quarantines ONLY that torn tail — the
+damaged bytes move to a ``.corrupt`` sidecar (kept for forensics), the
+file truncates to the last whole frame, and every prior record loads;
+``mode="raise"`` surfaces the typed
+:class:`~deequ_tpu.exceptions.CorruptStateException` instead. Damage
+is never silently skipped: frames are sequential, so nothing after the
+first tear is trusted.
+
+The quarantine ledger rides along: each accept frame carries the
+fleet's merged per-tenant quarantine snapshot, so a resumed coordinator
+restores WHO was quarantined along with what was queued (the
+``PendingWork`` contract, made durable).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deequ_tpu.exceptions import CorruptStateException
+from deequ_tpu.serve.transport import (
+    dump_blob,
+    encode_frame,
+    load_blob,
+    read_frame,
+)
+
+#: the one append-only ledger file inside a fleet's ledger_dir
+LEDGER_FILENAME = "requests.dql"
+#: torn tails recovered past are preserved here, never deleted
+CORRUPT_SUFFIX = ".corrupt"
+
+
+class RequestLedger:
+    """Append-only checksummed record of fleet-accepted work (see
+    module doc). Thread-safe appends (submit and resolve race from
+    different threads); recovery runs once, at open."""
+
+    def __init__(self, ledger_dir: str, mode: str = "recover"):
+        if mode not in ("recover", "raise"):
+            raise ValueError(
+                f"mode must be 'recover' or 'raise', got {mode!r}"
+            )
+        self.ledger_dir = ledger_dir
+        self.path = os.path.join(ledger_dir, LEDGER_FILENAME)
+        self.mode = mode
+        self._lock = threading.Lock()
+        self.records: List[dict] = []
+        self.torn_tail_bytes = 0
+        os.makedirs(ledger_dir, exist_ok=True)
+        self._recover()
+        self._handle = open(self.path, "ab")
+
+    # -- recovery --------------------------------------------------------
+
+    def _recover(self) -> None:
+        """Replay every whole frame; classify the first damage as a
+        torn tail (quarantine or raise per ``mode``). The scan stops at
+        the first tear — frames are sequential, nothing past it is
+        trusted."""
+        if not os.path.exists(self.path):
+            return
+        records: List[dict] = []
+        good_end = 0
+        error: Optional[CorruptStateException] = None
+        with open(self.path, "rb") as f:
+            while True:
+                try:
+                    msg = read_frame(
+                        f, f"request ledger {LEDGER_FILENAME}"
+                    )
+                except CorruptStateException as e:
+                    error = e
+                    break
+                if msg is None:
+                    break
+                records.append(msg)
+                good_end = f.tell()
+        self.records = records
+        if error is None:
+            return
+        if self.mode == "raise":
+            raise error
+        # quarantine ONLY the torn tail: damaged bytes to the sidecar,
+        # the ledger truncated to its last whole frame — every prior
+        # record stays live (the repository torn-segment rule at frame
+        # granularity)
+        size = os.path.getsize(self.path)
+        self.torn_tail_bytes = size - good_end
+        with open(self.path, "rb") as f:
+            f.seek(good_end)
+            tail = f.read()
+        with open(self.path + CORRUPT_SUFFIX, "ab") as sidecar:
+            sidecar.write(tail)
+            sidecar.flush()
+            os.fsync(sidecar.fileno())
+        with open(self.path, "ab") as f:
+            f.truncate(good_end)
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+        SCAN_STATS.record_degradation(
+            "ledger_torn_tail", path=self.path,
+            dropped_bytes=self.torn_tail_bytes, error=str(error),
+        )
+
+    # -- appends ---------------------------------------------------------
+
+    def _append(self, record: dict) -> None:
+        frame = encode_frame(record)
+        with self._lock:
+            self._handle.write(frame)
+            self._handle.flush()
+            os.fsync(self._handle.fileno())
+            self.records.append(record)
+        from deequ_tpu.obs.registry import LEDGER_APPENDS
+
+        LEDGER_APPENDS.inc()
+
+    def append_accept(
+        self,
+        accept_id: str,
+        *,
+        tenant: Any,
+        digest: str,
+        slo_cls: str,
+        deadline_ms: Optional[float],
+        weight: float,
+        deadline_left_s: Optional[float],
+        work: Any,
+        quarantine: Optional[dict] = None,
+    ) -> None:
+        """One accepted request, durable BEFORE its future is returned:
+        ``work`` is the (data, checks, required_analyzers) tuple —
+        everything a fresh coordinator needs to re-dispatch.
+        ``deadline_left_s`` is the deadline budget remaining at accept
+        (an absolute monotonic stamp would be meaningless in the
+        resuming process); ``accepted_wall`` (wall-clock, stamped here)
+        lets resume subtract the dead time so a request does not get
+        its deadline back just because the coordinator died."""
+        self._append({
+            "kind": "accept",
+            "id": accept_id,
+            "accepted_wall": time.time(),
+            "tenant_blob": dump_blob(tenant),
+            "digest": digest,
+            "slo": {
+                "cls": slo_cls,
+                "deadline_ms": deadline_ms,
+                "weight": weight,
+            },
+            "deadline_left_s": deadline_left_s,
+            "work_blob": dump_blob(work),
+            "quarantine_blob": (
+                dump_blob(quarantine) if quarantine is not None else None
+            ),
+        })
+
+    def append_resolve(self, accept_id: str) -> None:
+        """The tombstone: this accepted request resolved (result OR
+        typed rejection — either way the coordinator owes nothing)."""
+        self._append({"kind": "resolve", "id": accept_id})
+
+    # -- replay ----------------------------------------------------------
+
+    def outstanding(self) -> Dict[str, dict]:
+        """Accepted minus tombstoned, in accept order — the work a dead
+        coordinator still owed."""
+        out: Dict[str, dict] = {}
+        for rec in self.records:
+            if rec.get("kind") == "accept":
+                out[rec["id"]] = rec
+            elif rec.get("kind") == "resolve":
+                out.pop(rec.get("id"), None)
+        return out
+
+    def latest_quarantine(self) -> Optional[dict]:
+        """The most recent persisted quarantine snapshot (rides accept
+        frames), for restore at resume."""
+        snap = None
+        for rec in self.records:
+            blob = rec.get("quarantine_blob")
+            if rec.get("kind") == "accept" and blob is not None:
+                snap = blob
+        return load_blob(snap, "ledger quarantine") if snap else None
+
+    @staticmethod
+    def load_work(rec: dict) -> Tuple[Any, tuple, tuple]:
+        """Decode one accept record's (data, checks, required_analyzers)."""
+        return load_blob(rec["work_blob"], "ledger work record")
+
+    @staticmethod
+    def load_tenant(rec: dict) -> Any:
+        return load_blob(rec["tenant_blob"], "ledger tenant field")
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._handle.close()
+            except OSError:
+                pass

@@ -31,7 +31,10 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
 from deequ_tpu.exceptions import WorkerLostException
-from deequ_tpu.parallel.distributed import probe_liveness
+from deequ_tpu.parallel.distributed import (
+    run_liveness_check,
+    validate_loss_mode,
+)
 
 
 @dataclass
@@ -106,30 +109,24 @@ class FleetMembership:
         of ``check_peers``. ``"fail"`` raises typed
         ``WorkerLostException`` naming the lost workers; ``"degrade"``
         returns the report for the caller's failover path."""
-        if on_worker_loss not in ("fail", "degrade"):
-            raise ValueError(
-                f"on_worker_loss must be 'fail' or 'degrade', "
-                f"got {on_worker_loss!r}"
-            )
+        validate_loss_mode(on_worker_loss, "on_worker_loss")
         expected = sorted(self._members())
         report = WorkerLossReport(n_workers=len(expected))
         if not expected:
             return report
         probe = probe or self._default_probe
-        try:
-            alive, lost = probe_liveness(
-                expected,
-                timeout if timeout is not None else self.stall_timeout,
-                probe,
-            )
-        except TimeoutError as e:
-            # unattributable stall: every worker is suspect — even
-            # "degrade" cannot pick a failover target, so raise typed
-            # (the check_peers rule)
-            raise WorkerLostException(
+        # unattributable stall: every worker is suspect — even
+        # "degrade" cannot pick a failover target, so the shared core
+        # raises typed (the check_peers rule, one implementation)
+        alive, lost = run_liveness_check(
+            expected,
+            timeout if timeout is not None else self.stall_timeout,
+            probe,
+            lambda e: WorkerLostException(
                 f"fleet liveness probe timed out unattributably: {e}",
                 worker_ids=tuple(expected),
-            ) from e
+            ),
+        )
         report.surviving = alive
         report.lost = lost
         if lost and on_worker_loss == "fail":

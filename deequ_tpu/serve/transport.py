@@ -1,0 +1,339 @@
+"""Frame transport for the process fleet — length-prefixed checksummed
+messages over sockets, pipes, or an in-process loopback.
+
+The coordinator (:mod:`deequ_tpu.serve.pfleet`) and its worker
+processes (:mod:`deequ_tpu.serve.pworker`) speak a frame protocol whose
+envelope is the SAME checksummed format the resilience tier persists
+with (:mod:`deequ_tpu.resilience.atomic`): ``DQX1 | crc32(u32 LE) |
+length(i64 LE) | payload``. One format serves both the wire and the
+durable request ledger (:mod:`deequ_tpu.serve.ledger`) — a frame read
+off a socket and a frame replayed off disk validate through the
+identical ``unwrap_checksum`` path, and a torn read on either surfaces
+the same typed :class:`~deequ_tpu.exceptions.CorruptStateException`.
+
+Message payloads are JSON objects (the control fields stay greppable on
+the wire and in the ledger: ids, tenants, SLO class, ``retry_after_s``,
+queue depths). Python values JSON cannot carry — tables, checks,
+analyzers, results, typed exceptions, quarantine snapshots — ride as
+``blob`` fields: base64 text over pickle. That is a deliberate trust
+decision scoped to this transport's deployment shape (coordinator and
+workers are the SAME code on the SAME machine under one uid, exactly
+like multiprocessing's own pickle pipes); the transport never accepts
+frames from a network listener.
+
+Transports are INJECTABLE (the ``check_peers`` probe discipline applied
+to the data plane): :class:`SocketTransport` wraps a real socketpair fd
+shared with a spawned worker process, :class:`LoopbackTransport` wraps
+a pair of in-process queues so the identical protocol loop runs
+deterministically in a thread — tests and single-process deployments
+exercise the same frames, acks, refusals, and quarantine merges without
+paying process spawn.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import pickle
+import queue
+import select
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+from deequ_tpu.exceptions import CorruptStateException
+from deequ_tpu.resilience.atomic import (
+    CHECKSUM_MAGIC,
+    unwrap_checksum,
+    wrap_checksum,
+)
+
+#: envelope header size: magic(4) + crc32(4) + length(8)
+FRAME_HEADER_BYTES = 16
+
+_i64 = struct.Struct("<q")
+
+#: refuse frames whose declared payload length is absurd — a corrupted
+#: length field must surface typed, not as a multi-GB allocation
+MAX_FRAME_BYTES = 1 << 30
+
+
+# -- python-object blob fields ------------------------------------------------
+
+
+try:
+    # constraint assertions are closures/lambdas: stdlib pickle cannot
+    # ship a Check across the process boundary, cloudpickle can (it
+    # serializes the code object; the result still LOADS through plain
+    # ``pickle.loads``). Fall back to stdlib pickle where cloudpickle
+    # is absent — picklable payloads (tables, results, exceptions,
+    # quarantine snapshots) keep working; lambda-bearing checks then
+    # surface a normal PicklingError at submit.
+    import cloudpickle as _blob_pickler
+except ImportError:  # pragma: no cover - cloudpickle ships with jax stacks
+    _blob_pickler = pickle
+
+
+def dump_blob(obj: Any) -> str:
+    """Python object -> base64 text for a JSON ``blob`` field."""
+    return base64.b64encode(
+        _blob_pickler.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    ).decode("ascii")
+
+
+def load_blob(text: str, what: str = "transport blob") -> Any:
+    """Base64 ``blob`` field -> Python object; typed
+    CorruptStateException on undecodable bytes (damage is a state
+    fault, not a code fault)."""
+    try:
+        return pickle.loads(base64.b64decode(text.encode("ascii")))
+    except (ValueError, pickle.UnpicklingError, EOFError,
+            AttributeError, ImportError) as e:
+        raise CorruptStateException(what, f"undecodable blob: {e}") from e
+
+
+# -- frame codec --------------------------------------------------------------
+
+
+def encode_frame(msg: dict) -> bytes:
+    """Message dict -> one checksummed wire/ledger frame."""
+    return wrap_checksum(
+        json.dumps(msg, sort_keys=True, separators=(",", ":")).encode(
+            "utf-8"
+        )
+    )
+
+
+def decode_frame(frame: bytes, what: str = "transport frame") -> dict:
+    """One complete frame -> message dict; typed on any damage."""
+    payload = unwrap_checksum(frame, what)
+    try:
+        msg = json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CorruptStateException(
+            what, f"checksum passed but payload is not JSON: {e}"
+        ) from e
+    if not isinstance(msg, dict):
+        raise CorruptStateException(
+            what, f"frame payload is {type(msg).__name__}, not an object"
+        )
+    return msg
+
+
+def read_frame(stream: io.RawIOBase, what: str = "transport frame"
+               ) -> Optional[dict]:
+    """Read one frame off a blocking byte stream. Returns None on clean
+    EOF at a frame boundary; raises typed CorruptStateException on a
+    torn frame (EOF mid-header or mid-payload, bad magic, bad length,
+    crc mismatch)."""
+    header = _read_exact(stream, FRAME_HEADER_BYTES)
+    if header is None:
+        return None
+    if len(header) < FRAME_HEADER_BYTES:
+        raise CorruptStateException(
+            what, f"torn frame: EOF after {len(header)} header bytes"
+        )
+    if header[:4] != CHECKSUM_MAGIC:
+        raise CorruptStateException(what, "bad frame magic")
+    (length,) = _i64.unpack_from(header, 8)
+    if not 0 <= length <= MAX_FRAME_BYTES:
+        raise CorruptStateException(
+            what, f"implausible frame length {length}"
+        )
+    body = _read_exact(stream, length) if length else b""
+    if body is None or len(body) < length:
+        got = 0 if body is None else len(body)
+        raise CorruptStateException(
+            what, f"torn frame: EOF after {got} of {length} payload bytes"
+        )
+    return decode_frame(header + body, what)
+
+
+def _read_exact(stream, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None on immediate EOF, short bytes on
+    EOF mid-read (the caller classifies torn vs clean)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = stream.read(n - got)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        got += len(chunk)
+    if not chunks:
+        return None
+    return b"".join(chunks)
+
+
+# -- transports ---------------------------------------------------------------
+
+
+class TransportClosedError(ConnectionError):
+    """The peer endpoint is gone (clean close or process death). A
+    ConnectionError subtype on purpose: the coordinator's receive loop
+    treats it exactly like a died socket — worker loss, not state
+    corruption."""
+
+
+class Transport:
+    """One endpoint of a bidirectional frame channel. ``send`` is
+    thread-safe (a worker's service thread resolves results while its
+    protocol thread acks submissions); ``recv`` is single-consumer."""
+
+    def send(self, msg: dict) -> None:
+        raise NotImplementedError
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Next message, or None when ``timeout`` elapses with nothing
+        to read. Raises TransportClosedError once the peer is gone and
+        everything already received has been drained."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class SocketTransport(Transport):
+    """Frames over a connected stream socket (a ``socketpair`` whose
+    other fd was inherited by the worker process). SIGKILLing the peer
+    surfaces here as EOF/ECONNRESET -> TransportClosedError — the
+    process fleet's loss signal."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._sock.setblocking(True)
+        self._send_lock = threading.Lock()
+        self._closed = False
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    def send(self, msg: dict) -> None:
+        frame = encode_frame(msg)
+        with self._send_lock:
+            if self._closed:
+                raise TransportClosedError("transport is closed")
+            try:
+                self._sock.sendall(frame)
+            except OSError as e:
+                raise TransportClosedError(
+                    f"peer gone during send: {e}"
+                ) from e
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        """Exactly ``n`` bytes off the socket; None on EOF before the
+        first byte, short bytes on EOF mid-read."""
+        chunks = []
+        got = 0
+        while got < n:
+            try:
+                chunk = self._sock.recv(n - got)
+            except OSError as e:
+                raise TransportClosedError(f"peer gone: {e}") from e
+            if not chunk:
+                break
+            chunks.append(chunk)
+            got += len(chunk)
+        if not chunks:
+            return None
+        return b"".join(chunks)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        if self._closed:
+            raise TransportClosedError("transport is closed")
+        # the timeout gates frame ARRIVAL only: once the first byte of
+        # a frame is in, the read blocks to the frame boundary — a poll
+        # timeout must never tear a frame in half
+        if timeout is not None:
+            try:
+                ready, _, _ = select.select([self._sock], [], [], timeout)
+            except OSError as e:
+                raise TransportClosedError(f"peer gone: {e}") from e
+            if not ready:
+                return None
+        what = "socket frame"
+        header = self._recv_exact(FRAME_HEADER_BYTES)
+        if header is None:
+            raise TransportClosedError("peer closed the channel")
+        if len(header) < FRAME_HEADER_BYTES:
+            raise CorruptStateException(
+                what, f"torn frame: EOF after {len(header)} header bytes"
+            )
+        if header[:4] != CHECKSUM_MAGIC:
+            raise CorruptStateException(what, "bad frame magic")
+        (length,) = _i64.unpack_from(header, 8)
+        if not 0 <= length <= MAX_FRAME_BYTES:
+            raise CorruptStateException(
+                what, f"implausible frame length {length}"
+            )
+        body = self._recv_exact(length) if length else b""
+        if body is None or len(body) < length:
+            got = 0 if body is None else len(body)
+            raise CorruptStateException(
+                what,
+                f"torn frame: EOF after {got} of {length} payload bytes",
+            )
+        return decode_frame(header + body, what)
+
+    def close(self) -> None:
+        with self._send_lock:
+            self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class LoopbackTransport(Transport):
+    """In-process frame channel: a pair of queues carrying ENCODED
+    frames (encode/decode run for real, so a loopback test exercises
+    the same serialization the socket path does — a table that cannot
+    pickle fails identically on both)."""
+
+    def __init__(self, inbox: "queue.Queue", outbox: "queue.Queue"):
+        self._inbox = inbox
+        self._outbox = outbox
+        self._closed = threading.Event()
+        self._peer_closed = threading.Event()
+
+    @staticmethod
+    def pair() -> "tuple[LoopbackTransport, LoopbackTransport]":
+        a_to_b: "queue.Queue" = queue.Queue()
+        b_to_a: "queue.Queue" = queue.Queue()
+        a = LoopbackTransport(inbox=b_to_a, outbox=a_to_b)
+        b = LoopbackTransport(inbox=a_to_b, outbox=b_to_a)
+        a._peer = b  # type: ignore[attr-defined]
+        b._peer = a  # type: ignore[attr-defined]
+        return a, b
+
+    def send(self, msg: dict) -> None:
+        if self._closed.is_set() or self._peer_closed.is_set():
+            raise TransportClosedError("loopback peer is closed")
+        self._outbox.put(encode_frame(msg))
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[dict]:
+        if self._closed.is_set():
+            raise TransportClosedError("transport is closed")
+        try:
+            frame = self._inbox.get(
+                timeout=timeout if timeout is not None else None
+            )
+        except queue.Empty:
+            if self._peer_closed.is_set():
+                raise TransportClosedError("peer closed the channel")
+            return None
+        if frame is None:  # the peer's close sentinel
+            self._peer_closed.set()
+            raise TransportClosedError("peer closed the channel")
+        return decode_frame(frame, "loopback frame")
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        peer = getattr(self, "_peer", None)
+        if peer is not None:
+            peer._peer_closed.set()
+        self._outbox.put(None)
