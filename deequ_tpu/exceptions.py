@@ -182,6 +182,29 @@ class DeadlineExceededException(ServiceOverloadedException):
         self.waited_s = waited_s
 
 
+class StaleEpochException(ServeException):
+    """A fenced-out coordinator (serve/lease.py) tried to act: its lease
+    epoch is older than the highest epoch the cluster has observed — a
+    zombie that stalled through a lease takeover and woke up after a
+    successor resumed on the same ledger. Raised at ``submit()`` when
+    the on-disk lease outranks the coordinator's epoch, and sent back
+    typed by workers that refuse a stale-epoch dispatch frame, so a
+    split brain surfaces as a refusal instead of a double-resolution.
+
+    ``stale_epoch`` is the refused writer's epoch; ``current_epoch``
+    the highest epoch the refusing side has seen; ``holder`` names the
+    current lease holder when known. Like the backpressure family, the
+    fields decompose onto wire frames and reconstruct on the far side."""
+
+    def __init__(self, message: str, stale_epoch: Optional[int] = None,
+                 current_epoch: Optional[int] = None,
+                 holder: Optional[str] = None):
+        super().__init__(message)
+        self.stale_epoch = stale_epoch
+        self.current_epoch = current_epoch
+        self.holder = holder
+
+
 class RetryExhaustedException(MetricCalculationRuntimeException):
     """A retried I/O operation kept failing past the RetryPolicy's attempt
     budget or deadline. ``__cause__`` carries the last underlying error."""

@@ -52,6 +52,16 @@ Rules (stable ids; all severity "error" — the repo pass is a CI gate):
   worse, doesn't) on every replay, exactly the ``jit-impure`` failure
   class. Spans belong at the HOST seams around the program
   (``device_call``, the packing loops), never inside it.
+- ``durable-write`` — raw durable-write shapes in ``serve/``,
+  ``repository/``, ``control/``, ``resilience/``: ``open(..., "w"/"wb")``
+  (any write-mode open, builtin or ``fs.open``), ``os.fsync(...)``, and
+  ``os.rename``/``os.replace``. Durable state must route through the
+  shared atomic helper (``resilience/atomic.py``'s
+  ``atomic_write_bytes``: temp + fsync + rename under the checksum
+  envelope) so every store gets the same torn-write recovery story; the
+  legitimate exceptions (the helper's own internals, append-only
+  ledgers, forensic ``.corrupt`` sidecars) carry annotated ignores with
+  reasons.
 - ``suppress-reason`` — a ``# deequ-lint: ignore[rule]`` suppression
   without a reason. Suppressions are triage records; a bare one is a
   finding itself AND grants no suppression (the underlying finding
@@ -125,6 +135,12 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
         "profiles/", "suggestions/", "control/",
     ),
     "span-in-jit": ("",),
+    # PR 18: every module that persists durable state (the fleet ledger
+    # and lease, repository segments, the control-plane registry,
+    # checkpoint/chaos/atomic code itself) must write through the shared
+    # atomic temp+fsync+rename helper — a hand-rolled open("wb") there
+    # is a torn-write hazard the crashpoint matrix cannot vouch for.
+    "durable-write": ("serve/", "repository/", "control/", "resilience/"),
     "suppress-reason": ("",),
 }
 
@@ -629,6 +645,60 @@ def lint_source(
                     "deequ_tpu.exceptions taxonomy (Device*/"
                     "MetricCalculation*) or a precise builtin so the "
                     "fault ladder can dispatch on the type",
+                )
+
+    # -- durable-write ---------------------------------------------------
+    if in_scope("durable-write"):
+        def _write_mode(call: ast.Call) -> Optional[str]:
+            """The literal mode string when this is a write-mode open,
+            else None (reads, appends, and computed modes pass)."""
+            mode: Optional[ast.AST] = None
+            if len(call.args) >= 2:
+                mode = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if not isinstance(mode, ast.Constant) or not isinstance(
+                mode.value, str
+            ):
+                return None
+            return mode.value if "w" in mode.value else None
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func)
+            if not parts:
+                continue
+            if parts[-1] == "open":
+                mode = _write_mode(node)
+                if mode is not None:
+                    add(
+                        "durable-write",
+                        node,
+                        f"raw open(..., {mode!r}) in a durable-state "
+                        "module: route the write through "
+                        "resilience/atomic.atomic_write_bytes (temp + "
+                        "fsync + rename) so it gets torn-write recovery "
+                        "(or annotate why this write is not durable "
+                        "state)",
+                    )
+            elif parts[-2:] == ["os", "fsync"]:
+                add(
+                    "durable-write",
+                    node,
+                    "raw os.fsync in a durable-state module: the shared "
+                    "atomic helper owns the flush+fsync+rename sequence "
+                    "(annotate append-only protocols with a reason)",
+                )
+            elif parts[-2:] in (["os", "rename"], ["os", "replace"]):
+                add(
+                    "durable-write",
+                    node,
+                    f"raw {'.'.join(parts[-2:])} in a durable-state "
+                    "module: commit renames belong inside "
+                    "resilience/atomic.atomic_write_bytes (annotate "
+                    "non-durable file shuffling with a reason)",
                 )
 
     # -- suppress-reason -------------------------------------------------

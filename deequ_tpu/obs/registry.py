@@ -578,6 +578,25 @@ PFLEET_RESUMED = REGISTRY.counter(
     "outstanding ledger records a resuming coordinator replayed "
     "(coordinator kill-and-resume, serve/pfleet.py)",
 )
+FENCING_REJECTIONS = REGISTRY.counter(
+    "pfleet_fencing_rejections",
+    "submits refused typed (StaleEpochException) because the "
+    "coordinator's lease epoch was fenced out by a successor "
+    "(serve/lease.py, PR 18) — one per fence event plus one per "
+    "subsequent submit on the fenced coordinator",
+)
+ZOMBIE_RESULTS_IGNORED = REGISTRY.counter(
+    "pfleet_zombie_results_ignored",
+    "result frames a coordinator dropped because they carried a "
+    "stale epoch or arrived after it was fenced — the zombie side of "
+    "split-brain adds zero effects",
+)
+CRASHPOINTS_SURVIVED = REGISTRY.counter(
+    "crashpoints_survived",
+    "crashpoint-matrix cells (write seam x byte boundary, "
+    "resilience/vfs_faults.py) a durable store recovered from typed "
+    "with no silent data loss",
+)
 
 
 def _serve_section() -> dict:

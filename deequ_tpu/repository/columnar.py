@@ -536,9 +536,17 @@ class ColumnarMetricsRepository(MetricsRepository):
             # later save() appends a valid segment past the torn seq, a
             # reopen would otherwise see corrupt-before-valid "damage"
             # and raise in BOTH modes, permanently bricking the repo
+            # counter-suffixed sidecar names: after a quarantine the
+            # reopened repo recomputes _next_seq WITHOUT the torn file,
+            # so the same seq can tear again — the second quarantine
+            # must not overwrite the first's evidence
+            from deequ_tpu.resilience.atomic import quarantine_path
+
             for _seq, name, _exc in errors:
                 full = self._fs.join(self.path, name)
-                self._fs.rename(full, full + CORRUPT_SUFFIX)
+                self._fs.rename(
+                    full, quarantine_path(self._fs, full, CORRUPT_SUFFIX)
+                )
             REPO_STATS.torn_segments_dropped += len(errors)
         self._segments = loaded
         self._next_seq = (files[-1][0] + 1) if files else 0

@@ -67,12 +67,47 @@ def unwrap_checksum(data: bytes, what: str) -> bytes:
 
 
 def _fsync_if_possible(handle) -> None:
-    try:
+    """Flush + fsync ``handle`` before the commit rename. A handle that
+    exposes its own ``fsync()`` (fault-injection seams, remote-store
+    writers) routes through it, and its failures PROPAGATE — as do
+    real-fd ``os.fsync``/``flush`` failures (ENOSPC surfaces at flush, a
+    lying fsync at fsync): a durability fault must fail the write typed
+    while the rename is still unreached, so the destination keeps its
+    previous complete version. Only handles with no fd at all
+    (in-memory / object-store writers) skip the fsync — rename still
+    gives all-or-nothing visibility there."""
+    fsync_hook = getattr(handle, "fsync", None)
+    if callable(fsync_hook):
         handle.flush()
-        os.fsync(handle.fileno())
-    except (AttributeError, OSError, ValueError):
-        pass  # in-memory / object-store handles have no fd; rename still
-        # gives all-or-nothing visibility there
+        fsync_hook()
+        return
+    try:
+        fd = handle.fileno()
+    except (AttributeError, ValueError, OSError):
+        try:
+            handle.flush()
+        except (AttributeError, ValueError):
+            pass  # in-memory / object-store handles have no fd; rename
+            # still gives all-or-nothing visibility there
+        return
+    handle.flush()
+    os.fsync(fd)  # deequ-lint: ignore[durable-write] -- this IS the shared helper's fsync leg; every durable writer routes here
+
+
+def quarantine_path(fs, path: str, suffix: str = ".corrupt") -> str:
+    """First unused quarantine-sidecar name for ``path``: ``path +
+    suffix``, then ``.corrupt.1``, ``.corrupt.2``, … Recovery code
+    moves damaged bytes aside as forensic evidence; a SECOND torn-write
+    recovery in the same directory must never overwrite the first
+    sidecar (``os.replace`` clobbers silently). Pass ``fs=None`` for
+    raw-``os`` callers (the request ledger's append path)."""
+    exists = os.path.exists if fs is None else fs.exists
+    candidate = path + suffix
+    n = 0
+    while exists(candidate):
+        n += 1
+        candidate = f"{path}{suffix}.{n}"
+    return candidate
 
 
 def atomic_write_bytes(
@@ -88,6 +123,7 @@ def atomic_write_bytes(
     tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
 
     def attempt() -> None:
+        # deequ-lint: ignore[durable-write] -- this IS the shared helper: the temp-file write the commit rename below makes atomic
         with fs.open(tmp, "wb") as f:
             f.write(data)
             _fsync_if_possible(f)

@@ -29,7 +29,14 @@ existing injector seam into one timeline —
   transport EOF, failover must re-dispatch bit-identically) and
   ``coord_kill9`` (the COORDINATOR dies mid-wave and a fresh one
   resumes off the durable request ledger, onto the original futures).
-  Any schedule with those kinds runs the process-fleet scenario;
+  A third kind, ``partition`` (round 18), is the SPLIT-BRAIN seam:
+  the coordinator is stalled-not-dead — a fresh coordinator resumes
+  off the ledger while the old incarnation stays alive with live
+  workers, then wakes mid-resume and tries to keep serving. The epoch
+  fence (serve/lease.py) must refuse every zombie dispatch typed
+  (``StaleEpochException``), with zero double-resolutions and the
+  completed results bit-identical. Any schedule with those kinds runs
+  the process-fleet scenario;
 - ``load``  — overload faults (round 15, the admission tier): scripted
   OPEN-LOOP SPIKES (a flood tenant bursts tight-deadline best_effort
   submissions mid-wave, no pacing) and SLOW-TENANT stalls (the worker
@@ -148,8 +155,8 @@ _WORKER_KINDS = ("death", "stall", "rejoin")
 #: wall-clock; the scripted kills are the expensive part being tested
 PFLEET_WAVES = 2
 #: worker-seam kinds that select the PROCESS-fleet scenario
-_PWORKER_KINDS = ("kill9", "rejoin", "coord_kill9")
-_PWORKER_ONLY_KINDS = ("kill9", "coord_kill9")
+_PWORKER_KINDS = ("kill9", "rejoin", "coord_kill9", "partition")
+_PWORKER_ONLY_KINDS = ("kill9", "coord_kill9", "partition")
 
 #: fleet membership knobs for the scenario: a heartbeat probe every
 #: 50ms, a worker declared lost after 0.3s of silence
@@ -376,10 +383,12 @@ class ChaosSchedule:
     def generate_pworker(seed: int) -> "ChaosSchedule":
         """Seeded PROCESS-fleet schedule (the kill -9 seam): scripted
         ``kill9`` (real SIGKILL on a worker process), ``rejoin``, and
-        at most one ``coord_kill9`` (coordinator death + ledger-backed
-        resume) over the waves. Same survivor discipline as
+        at most one COORDINATOR event over the waves — ``coord_kill9``
+        (coordinator death + ledger-backed resume) or ``partition``
+        (split brain: the old coordinator survives as a zombie and
+        must be epoch-fenced). Same survivor discipline as
         :meth:`generate_worker` — every schedule must leave somewhere
-        to fail over TO. A ``coord_kill9`` resets the down-set: the
+        to fail over TO. A coordinator event resets the down-set: the
         resumed coordinator spawns a full fresh fleet."""
         rng = Random(seed)
         events: List[dict] = []
@@ -395,16 +404,15 @@ class ChaosSchedule:
             if down:
                 kinds += ["rejoin"]
             if not used_coord:
-                kinds += ["coord_kill9"]
+                kinds += ["coord_kill9", "partition"]
             if not kinds:
                 continue
             kind = rng.choice(kinds)
-            if kind == "coord_kill9":
+            if kind in ("coord_kill9", "partition"):
                 used_coord = True
                 down = set()
                 events.append(
-                    {"seam": "worker", "kind": "coord_kill9",
-                     "wave": wave}
+                    {"seam": "worker", "kind": kind, "wave": wave}
                 )
                 continue
             if kind == "rejoin":
@@ -1108,6 +1116,23 @@ def _check_worker_oracles(
             f"({fl})"
         )
 
+    # 8b. split-brain fencing (partition seam): every dispatch a zombie
+    # coordinator attempted after losing the lease must have been
+    # refused typed — zero stale-epoch effects reach the system
+    if fl.get("zombie_unfenced"):
+        v.append(
+            f"fencing: {fl['zombie_unfenced']} zombie dispatches were "
+            "ACCEPTED after a partition (epoch fence failed)"
+        )
+    n_partitions = sum(
+        1 for row in report.injected if row[1] == "partition"
+    )
+    if n_partitions and fl.get("zombie_fenced", 0) < n_partitions:
+        v.append(
+            f"fencing: {n_partitions} partition(s) applied but only "
+            f"{fl.get('zombie_fenced', 0)} zombie dispatches were fenced"
+        )
+
     # fetch contract: the serving path's one-fetch-per-coalesced-batch
     # discipline bounds fetches by scan passes, failover included
     if report.scan_delta.get("device_fetches", 0) > report.scan_delta.get(
@@ -1152,7 +1177,11 @@ def _apply_pworker_event(state: dict, event: dict, resume_map) -> None:
     abandons the coordinator object wholesale — what SIGKILL does to
     its threads, sockets, and ledger handle — and resumes a FRESH
     :class:`~deequ_tpu.serve.pfleet.ProcessFleet` off the durable
-    ledger, onto the original futures (``resume_map``)."""
+    ledger, onto the original futures (``resume_map``); ``partition``
+    is the split-brain seam: the old coordinator is NOT abandoned — it
+    survives with live workers while the fresh one resumes, then wakes
+    mid-resume and attempts another dispatch, which the epoch fence
+    must refuse typed (zombie accounting feeds the fencing oracle)."""
     from deequ_tpu.serve.pfleet import ProcessFleet
 
     kind = event["kind"]
@@ -1161,6 +1190,34 @@ def _apply_pworker_event(state: dict, event: dict, resume_map) -> None:
         fleet.kill_worker(int(event["worker"]), reason="chaos kill -9")
     elif kind == "rejoin":
         fleet.rejoin_worker(int(event["worker"]))
+    elif kind == "partition":
+        from deequ_tpu.exceptions import StaleEpochException
+
+        state["workers_lost"] += fleet.workers_lost
+        state["redispatched"] += fleet.requests_redispatched
+        # the zombie stays fully alive: threads, worker processes,
+        # ledger handle — only the LEASE decides who owns the epoch
+        state["zombies"].append(fleet)
+        state["fleet"] = ProcessFleet(
+            n_workers=FLEET_N_WORKERS,
+            transport=state["transport"],
+            ledger_dir=state["ledger_dir"],
+            heartbeat_interval=FLEET_HEARTBEAT,
+            stall_timeout=FLEET_STALL_TIMEOUT,
+            monitor=False,
+            resume_futures=resume_map(),
+        )
+        state["resumed"] += len(state["fleet"].resumed)
+        # the zombie wakes mid-resume and tries to keep serving: its
+        # dispatch must be refused by the epoch fence, not accepted
+        try:
+            state["zombies"][-1].submit(
+                state["probe"], [_check()],
+                required_analyzers=_analyzers(), tenant="t0",
+            )
+            state["zombie_unfenced"] += 1
+        except StaleEpochException:
+            state["zombie_fenced"] += 1
     elif kind == "coord_kill9":
         # the old incarnation's loss counters must survive the swap —
         # the report accounts for the whole timeline, not one fleet
@@ -1222,6 +1279,12 @@ def _run_pworker_schedule(
         "workers_lost": 0,
         "redispatched": 0,
         "resumed": 0,
+        # split-brain (partition) accounting: the surviving old
+        # coordinators, and how their post-partition dispatches fared
+        "zombies": [],
+        "zombie_fenced": 0,
+        "zombie_unfenced": 0,
+        "probe": tenants[0],
     }
 
     def resume_map():
@@ -1290,6 +1353,12 @@ def _run_pworker_schedule(
         try:
             state["fleet"].stop(drain=True)
         finally:
+            for zombie in state["zombies"]:
+                try:
+                    zombie.stop(drain=False)
+                # deequ-lint: ignore[bare-except] -- zombie teardown is best-effort: a fenced coordinator's workers may already be gone
+                except Exception:  # noqa: BLE001
+                    pass
             shutil.rmtree(ledger_dir, ignore_errors=True)
     elapsed = time.monotonic() - t0
     reg_after = REGISTRY.snapshot()
@@ -1343,6 +1412,8 @@ def _run_pworker_schedule(
                 state["redispatched"] + final.requests_redispatched
             ),
             "resumed": state["resumed"],
+            "zombie_fenced": state["zombie_fenced"],
+            "zombie_unfenced": state["zombie_unfenced"],
         },
     )
 

@@ -1,0 +1,699 @@
+"""OS-level write seams + the crashpoint matrix over every durable store.
+
+``resilience/faults.py`` injects faults at the *FileSystem operation*
+level (an open that raises, a writer that tears on close). This module
+goes one layer deeper: it models the five ways a single durable write
+can die at the *OS* level, and drives each seam at **every byte
+boundary** of the write against every durable store in the system —
+the request ledger, repository segments, the control-plane registry,
+and stream checkpoints — asserting the store's documented recovery
+contract uniformly (typed detection, last-whole-frame/previous-version
+semantics, ``.corrupt`` forensic sidecars, never silent loss).
+
+The write seams (``WRITE_SEAMS``):
+
+- ``enospc``          — the disk fills mid-write: a prefix lands in the
+                        temp file, ``write`` raises ``OSError(ENOSPC)``.
+- ``short_write``     — a lying stack: write+fsync+close all report
+                        success but only a prefix is durable. The commit
+                        rename proceeds, so the DESTINATION is torn —
+                        the one seam only checksums can catch.
+- ``fsync_raises``    — fsync returns an error (lost write): a prefix is
+                        durable, the writer sees ``OSError(EIO)`` before
+                        the rename, so the destination keeps its
+                        previous complete version.
+- ``crash_before_fsync`` — the process dies after writing, before fsync:
+                        a torn temp file survives, nothing was renamed,
+                        and no cleanup code ever ran.
+- ``crash_at_rename`` — the process dies at the commit point: a COMPLETE
+                        temp file survives, the destination is old.
+
+Crashes are modelled by ``SimulatedCrash`` deriving from
+``BaseException``: best-effort ``except Exception`` layers (checkpoint
+saves, cleanup paths) must NOT absorb a process death, and after a
+crash the filesystem freezes — ``delete``/``rename`` silently no-op, so
+``atomic_write_bytes``'s temp-file cleanup leaves exactly the litter a
+real crash would.
+
+The request ledger appends raw frames to a local file (no FileSystem
+indirection, fsync-per-frame), so its matrix column is driven by the
+equivalent physical outcome: the appended frame truncated at every byte
+boundary (``torn_tail``), which is what any of the crash seams leaves
+on disk for an append-only file.
+
+``run_crashpoint_matrix`` sweeps seams x byte boundaries x stores; each
+surviving cell increments the ``crashpoints_survived`` counter and any
+violated invariant raises ``CrashpointViolation`` naming the exact
+(store, seam, cut) cell that broke.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+from deequ_tpu.data.fs import (
+    FileSystem,
+    InMemoryFileSystem,
+    register_filesystem,
+)
+from deequ_tpu.exceptions import (
+    CorruptStateException,
+    RetryExhaustedException,
+)
+from deequ_tpu.resilience.retry import (
+    RetryPolicy,
+    default_retry_policy,
+    set_default_retry_policy,
+)
+
+WRITE_SEAMS = (
+    "enospc",
+    "short_write",
+    "fsync_raises",
+    "crash_before_fsync",
+    "crash_at_rename",
+)
+
+#: single attempt, no backoff sleeps: the matrix asserts the UNretried
+#: recovery paths (and a thousand cells must not sleep through backoff)
+ONE_SHOT_RETRY = RetryPolicy(max_attempts=1, base_delay=0.0)
+
+
+class SimulatedCrash(BaseException):
+    """Process death at a write seam. Derives from ``BaseException`` on
+    purpose: a crash must sail through every best-effort ``except
+    Exception`` (checkpoint saves, cleanup handlers) exactly as a real
+    SIGKILL would."""
+
+    def __init__(self, seam: str, path: str):
+        super().__init__(f"simulated crash at seam {seam!r} writing {path}")
+        self.seam = seam
+        self.path = path
+
+
+class CrashpointViolation(AssertionError):
+    """One matrix cell broke its store's recovery contract."""
+
+    def __init__(self, store: str, seam: str, cut: int, detail: str):
+        super().__init__(
+            f"crashpoint violation: store={store} seam={seam} "
+            f"cut_byte={cut}: {detail}"
+        )
+        self.store = store
+        self.seam = seam
+        self.cut = cut
+        self.detail = detail
+
+
+class _SeamWriter:
+    """Write handle that buffers everything and applies the owning
+    filesystem's seam at the configured byte cut. Exposes ``fsync()`` so
+    ``_fsync_if_possible`` routes durability through the seam (the
+    fsync-raises / crash-before-fsync trigger point)."""
+
+    def __init__(self, fs: "WriteSeamFileSystem", path: str):
+        self._fs = fs
+        self._path = path
+        self._buf = bytearray()
+        self._closed = False
+
+    def write(self, data) -> int:
+        fs = self._fs
+        self._buf += data
+        if fs.seam == "enospc" and len(self._buf) > fs.at_byte:
+            fs.fired = True
+            self._commit(fs.at_byte)
+            raise OSError(
+                errno.ENOSPC, "no space left on device (injected)"
+            )
+        return len(data)
+
+    def flush(self) -> None:
+        pass
+
+    def fsync(self) -> None:
+        fs = self._fs
+        if fs.seam == "fsync_raises":
+            fs.fired = True
+            self._commit(fs.at_byte)
+            raise OSError(errno.EIO, "fsync reported lost write (injected)")
+        if fs.seam == "crash_before_fsync":
+            fs.fired = True
+            fs.crashed = True
+            self._commit(fs.at_byte)
+            raise SimulatedCrash("crash_before_fsync", self._path)
+        # short_write IS the lying-fsync seam: report success, persist
+        # only the cut prefix at close. Other seams are durable here.
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        fs = self._fs
+        if fs.crashed:
+            return  # nothing after a crash runs
+        if fs.seam == "short_write" and len(self._buf) > fs.at_byte:
+            fs.fired = True
+            self._commit(fs.at_byte)
+            return
+        self._commit(len(self._buf))
+        fs.last_write_len = len(self._buf)
+
+    def _commit(self, n: int) -> None:
+        # deequ-lint: ignore[durable-write] -- this IS the seam simulator: it materializes exactly the prefix the injected fault would leave durable
+        with self._fs.inner.open(self._path, "wb") as f:
+            f.write(bytes(self._buf[:n]))
+
+    def __enter__(self) -> "_SeamWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # a seam that fired inside the with-body already decided what is
+        # durable; closing again on unwind must not re-commit
+        if exc[0] is None:
+            self.close()
+        else:
+            self._closed = True
+
+
+class WriteSeamFileSystem(FileSystem):
+    """FileSystem proxy that applies ONE write seam at ONE byte cut to
+    write-mode opens, then freezes (``crashed``) if the seam was a
+    process death: subsequent ``delete``/``rename`` silently no-op, so
+    in-flight cleanup handlers leave the same litter a real crash
+    would. ``seam=None`` is a pure recorder (used to measure a store's
+    write length for the byte grid)."""
+
+    def __init__(
+        self,
+        inner: FileSystem,
+        seam: Optional[str] = None,
+        at_byte: int = 0,
+        path_substr: Optional[str] = None,
+    ):
+        if seam is not None and seam not in WRITE_SEAMS:
+            raise ValueError(
+                f"seam must be one of {WRITE_SEAMS} or None, got {seam!r}"
+            )
+        self.inner = inner
+        self.seam = seam
+        self.at_byte = int(at_byte)
+        self.path_substr = path_substr
+        self.fired = False
+        self.crashed = False
+        self.last_write_len = 0
+
+    def _matches(self, path: str) -> bool:
+        return self.path_substr is None or self.path_substr in path
+
+    def open(self, path: str, mode: str = "rb"):
+        if "w" in mode and "b" in mode and self._matches(path):
+            return _SeamWriter(self, path)
+        return self.inner.open(path, mode)
+
+    def exists(self, path: str) -> bool:
+        return self.inner.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        self.inner.makedirs(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return self.inner.listdir(path)
+
+    def delete(self, path: str) -> None:
+        if self.crashed:
+            return  # crashed processes do not clean up their temp files
+        self.inner.delete(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        if self.crashed:
+            return
+        if self.seam == "crash_at_rename" and self._matches(src):
+            self.fired = True
+            self.crashed = True
+            raise SimulatedCrash("crash_at_rename", src)
+        self.inner.rename(src, dst)
+
+    def join(self, *parts: str) -> str:
+        return self.inner.join(*parts)
+
+
+# -- crashfs:// mount point ----------------------------------------------
+#
+# Stores resolve their FileSystem from the path scheme, so the matrix
+# mounts the per-cell filesystem (plain for baseline/verify, seamed for
+# the attempt) behind one scheme and hands stores crashfs:// paths.
+
+_CRASHFS: Dict[str, Optional[FileSystem]] = {"fs": None}
+
+
+def _crashfs_factory(path: str) -> FileSystem:
+    fs = _CRASHFS["fs"]
+    if fs is None:
+        raise LookupError(
+            f"crashfs:// not mounted (resolving {path!r} outside a "
+            "crashpoint-matrix cell)"
+        )
+    return fs
+
+
+register_filesystem("crashfs", _crashfs_factory)
+
+
+def _mount(fs: Optional[FileSystem]) -> None:
+    _CRASHFS["fs"] = fs
+
+
+#: errors a dying durable write may legitimately surface to its caller.
+#: Anything else escaping an attempt is an UNTYPED leak and fails the
+#: cell. SimulatedCrash is listed explicitly (BaseException).
+TYPED_ATTEMPT_ERRORS = (
+    OSError,
+    CorruptStateException,
+    RetryExhaustedException,
+    SimulatedCrash,
+)
+
+
+class _FsStoreAdapter:
+    """One durable store driven through the crashfs:// mount. Subclasses
+    define ``baseline`` (prior durable state, written through a healthy
+    filesystem), ``attempt`` (the ONE durable write the seam kills), and
+    ``verify`` (reboot view: fresh store over the bare inner filesystem,
+    asserting the recovery contract)."""
+
+    name = "store"
+    seams: Tuple[str, ...] = WRITE_SEAMS
+
+    def baseline(self) -> None:
+        raise NotImplementedError
+
+    def attempt(self) -> None:
+        raise NotImplementedError
+
+    def verify(self, inner, seam, cut, length, err) -> None:
+        raise NotImplementedError
+
+    # -- driver ----------------------------------------------------------
+
+    def measure_write_len(self) -> int:
+        """Dry-run the attempt against a recorder to size the byte grid."""
+        inner = InMemoryFileSystem()
+        _mount(inner)
+        self.baseline()
+        probe = WriteSeamFileSystem(inner)
+        _mount(probe)
+        self.attempt()
+        _mount(None)
+        if probe.last_write_len <= 0:
+            raise CrashpointViolation(
+                self.name, "measure", -1,
+                "attempt() performed no durable write",
+            )
+        return probe.last_write_len
+
+    def run_cell(self, seam: str, cut: int, length: int) -> None:
+        inner = InMemoryFileSystem()
+        _mount(inner)
+        self.baseline()
+        seamed = WriteSeamFileSystem(inner, seam, cut)
+        _mount(seamed)
+        err: Optional[BaseException] = None
+        try:
+            self.attempt()
+        except TYPED_ATTEMPT_ERRORS as e:
+            err = e
+        except BaseException as e:  # noqa: BLE001 — untyped leak = violation
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"attempt leaked untyped {type(e).__name__}: {e}",
+            ) from e
+        finally:
+            _mount(None)
+        _mount(inner)
+        try:
+            self.verify(inner, seam, cut, length, err)
+        except CrashpointViolation:
+            raise
+        except BaseException as e:  # noqa: BLE001 — reboot must not fail untyped
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"verify after reboot raised {type(e).__name__}: {e}",
+            ) from e
+        finally:
+            _mount(None)
+
+    def run_matrix(self, stride: int = 1) -> Dict[str, Any]:
+        from deequ_tpu.obs.registry import CRASHPOINTS_SURVIVED
+
+        length = self.measure_write_len()
+        by_seam: Dict[str, int] = {}
+        for seam in self.seams:
+            if seam == "crash_at_rename":
+                cuts = [length]  # the write completed; the cut is moot
+            else:
+                cuts = list(range(0, length + 1, max(int(stride), 1)))
+                if cuts[-1] != length:
+                    cuts.append(length)  # always include the healthy cell
+            for cut in cuts:
+                self.run_cell(seam, cut, length)
+                CRASHPOINTS_SURVIVED.inc()
+            by_seam[seam] = len(cuts)
+        return {
+            "write_len": length,
+            "cells": sum(by_seam.values()),
+            "by_seam": by_seam,
+        }
+
+
+def _new_write_expected(seam: str, cut: int, length: int) -> bool:
+    """Whether the attempted write must be durably visible after reboot:
+    only when the seam never actually fired (cut past the payload) or
+    the torn commit happened to cover the whole payload."""
+    return seam in ("enospc", "short_write") and cut >= length
+
+
+class RepositorySegmentAdapter(_FsStoreAdapter):
+    """Columnar metrics repository: segment files are checksummed and
+    committed by rename; a torn TAIL segment quarantines to a
+    ``.corrupt`` sidecar under ``on_torn_segment='recover'`` while every
+    prior segment stays live."""
+
+    name = "repository_segment"
+    path = "crashfs://repo"
+
+    @staticmethod
+    def _result(date: int):
+        from deequ_tpu.analyzers import Completeness, Size
+        from deequ_tpu.analyzers.runner import AnalyzerContext
+        from deequ_tpu.metrics import DoubleMetric, Entity
+        from deequ_tpu.repository import AnalysisResult, ResultKey
+        from deequ_tpu.tryresult import Success
+
+        mm = {
+            Completeness("col_a"): DoubleMetric(
+                Entity.COLUMN, "Completeness", "col_a",
+                Success(0.25 * date),
+            ),
+            Size(): DoubleMetric(
+                Entity.DATASET, "Size", "*", Success(float(100 + date))
+            ),
+        }
+        return AnalysisResult(ResultKey(date), AnalyzerContext(mm))
+
+    def _repo(self):
+        from deequ_tpu.repository.columnar import ColumnarMetricsRepository
+
+        return ColumnarMetricsRepository(
+            self.path, on_torn_segment="recover", retry=ONE_SHOT_RETRY
+        )
+
+    def baseline(self) -> None:
+        self._repo().save(self._result(1))
+
+    def attempt(self) -> None:
+        self._repo().save(self._result(2))
+
+    def verify(self, inner, seam, cut, length, err) -> None:
+        from deequ_tpu.repository import ResultKey
+
+        repo = self._repo()
+        r1 = repo.load_by_key(ResultKey(1))
+        if r1 is None:
+            raise CrashpointViolation(
+                self.name, seam, cut, "baseline segment lost"
+            )
+        if len(r1.analyzer_context.metric_map) != 2:
+            raise CrashpointViolation(
+                self.name, seam, cut, "baseline result decoded incomplete"
+            )
+        r2 = repo.load_by_key(ResultKey(2))
+        if _new_write_expected(seam, cut, length):
+            if r2 is None:
+                raise CrashpointViolation(
+                    self.name, seam, cut,
+                    "healthy-cut write missing after reboot",
+                )
+        elif r2 is not None:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                "torn/failed segment readable after reboot "
+                "(should be absent or quarantined)",
+            )
+        if seam == "short_write" and cut < length:
+            names = inner.listdir(self.path)
+            if not any(".corrupt" in n for n in names):
+                raise CrashpointViolation(
+                    self.name, seam, cut,
+                    f"torn committed segment not quarantined (saw {names})",
+                )
+
+
+class ControlRegistryAdapter(_FsStoreAdapter):
+    """Control-plane check registry: single checksummed JSON state file,
+    atomically replaced on every mutation. Its recovery posture is
+    raise-typed (``CorruptStateException``), never silently reset."""
+
+    name = "control_registry"
+    path = "crashfs://ctrl"
+
+    def _registry(self):
+        from deequ_tpu.control.registry import CheckRegistry
+
+        return CheckRegistry(self.path, retry=ONE_SHOT_RETRY)
+
+    @staticmethod
+    def _candidate(reg, n: int) -> None:
+        reg.register_candidate(
+            f"chk_{n}", tenant="t1", column="col_a", rule="CompleteIf",
+            code=f"hasCompleteness(col_a, >= 0.{n})",
+            description=f"candidate {n}", current_value="1.0",
+        )
+
+    def baseline(self) -> None:
+        self._candidate(self._registry(), 1)
+
+    def attempt(self) -> None:
+        self._candidate(self._registry(), 2)
+
+    def verify(self, inner, seam, cut, length, err) -> None:
+        torn_commit = seam == "short_write" and cut < length
+        try:
+            reg = self._registry()
+        except CorruptStateException:
+            if torn_commit:
+                return  # torn committed state detected typed: contract held
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                "registry state unreadable though the commit rename "
+                "never ran",
+            )
+        if torn_commit:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                "torn committed registry state loaded without typed error",
+            )
+        if reg.get("chk_1") is None:
+            raise CrashpointViolation(
+                self.name, seam, cut, "baseline candidate lost"
+            )
+        has_new = reg.get("chk_2") is not None
+        if has_new != _new_write_expected(seam, cut, length):
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"attempted candidate visibility wrong (present={has_new})",
+            )
+
+
+class StreamCheckpointAdapter(_FsStoreAdapter):
+    """Streaming checkpoints: atomic + checksummed, with fallback — a
+    damaged newest checkpoint is skipped in favor of its predecessor,
+    never fatal (worst case the run restarts the interval)."""
+
+    name = "stream_checkpoint"
+    path = "crashfs://ckpt"
+    fingerprint = "vfsmatrix|fp"
+
+    def _ckpt(self):
+        from deequ_tpu.resilience.checkpoint import StreamCheckpointer
+
+        return StreamCheckpointer(self.path, keep=4, retry=ONE_SHOT_RETRY)
+
+    def baseline(self) -> None:
+        from deequ_tpu.resilience.checkpoint import StreamCheckpoint
+
+        if not self._ckpt().save(self.fingerprint, StreamCheckpoint(8)):
+            raise CrashpointViolation(
+                self.name, "baseline", -1,
+                "baseline checkpoint save failed on a healthy filesystem",
+            )
+
+    def attempt(self) -> None:
+        from deequ_tpu.resilience.checkpoint import StreamCheckpoint
+
+        self._ckpt().save(self.fingerprint, StreamCheckpoint(16))
+
+    def verify(self, inner, seam, cut, length, err) -> None:
+        got = self._ckpt().load_latest(self.fingerprint)
+        if got is None:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                "no checkpoint recoverable (baseline must survive)",
+            )
+        want = 16 if _new_write_expected(seam, cut, length) else 8
+        if got.batch_index != want:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"resumed from batch {got.batch_index}, expected {want}",
+            )
+
+
+class RequestLedgerAdapter:
+    """Request ledger: append-only frames, fsync-per-frame, raw local
+    file I/O. Every crash seam leaves the same physical outcome for an
+    append — the new frame truncated at some byte — so its matrix
+    column is the ``torn_tail`` sweep: the appended frame cut at every
+    byte, asserting last-whole-frame recovery, the counter-suffixed
+    ``.corrupt`` sidecar, and zero loss of prior records."""
+
+    name = "request_ledger"
+    seams: Tuple[str, ...] = ("torn_tail",)
+
+    @staticmethod
+    def _accept(led, accept_id: str, epoch: int) -> None:
+        led.append_accept(
+            accept_id, tenant={"tables": accept_id}, digest=f"d-{accept_id}",
+            slo_cls="batch", deadline_ms=None, weight=1.0,
+            deadline_left_s=None, work=("data", "checks", "analyzers"),
+            epoch=epoch,
+        )
+
+    def _materialize(self) -> Tuple[bytes, bytes]:
+        """(baseline ledger bytes, the one appended frame's bytes)."""
+        from deequ_tpu.serve.ledger import RequestLedger
+
+        with tempfile.TemporaryDirectory() as tmp:
+            led = RequestLedger(tmp)
+            self._accept(led, "a1", 1)
+            self._accept(led, "a2", 1)
+            led.append_resolve("a1", epoch=1)
+            led.close()
+            with open(led.path, "rb") as f:
+                base = f.read()
+            led2 = RequestLedger(tmp)
+            self._accept(led2, "a3", 1)
+            led2.close()
+            with open(led2.path, "rb") as f:
+                frame = f.read()[len(base):]
+        return base, frame
+
+    def run_cell(self, base: bytes, frame: bytes, cut: int) -> None:
+        from deequ_tpu.serve.ledger import (
+            CORRUPT_SUFFIX,
+            LEDGER_FILENAME,
+            RequestLedger,
+        )
+
+        with tempfile.TemporaryDirectory() as tmp:
+            led_path = os.path.join(tmp, LEDGER_FILENAME)
+            # deequ-lint: ignore[durable-write] -- cell fixture: materializing the intentionally-torn post-crash file under test
+            with open(led_path, "wb") as f:
+                f.write(base + frame[:cut])
+            try:
+                led = RequestLedger(tmp, mode="recover")
+            except BaseException as e:  # noqa: BLE001 — recovery must not raise
+                raise CrashpointViolation(
+                    self.name, "torn_tail", cut,
+                    f"recovery raised {type(e).__name__}: {e}",
+                ) from e
+            try:
+                live = led.outstanding()
+                if "a2" not in live:
+                    raise CrashpointViolation(
+                        self.name, "torn_tail", cut,
+                        "prior outstanding accept lost",
+                    )
+                if "a1" in live:
+                    raise CrashpointViolation(
+                        self.name, "torn_tail", cut,
+                        "resolved accept resurrected",
+                    )
+                whole = cut == len(frame)
+                if ("a3" in live) != whole:
+                    raise CrashpointViolation(
+                        self.name, "torn_tail", cut,
+                        f"torn-frame visibility wrong (cut={cut}, "
+                        f"frame={len(frame)})",
+                    )
+                sidecar = led.path + CORRUPT_SUFFIX
+                if 0 < cut < len(frame):
+                    if not os.path.exists(sidecar):
+                        raise CrashpointViolation(
+                            self.name, "torn_tail", cut,
+                            "torn tail not quarantined to sidecar",
+                        )
+                    if led.torn_tail_bytes != cut:
+                        raise CrashpointViolation(
+                            self.name, "torn_tail", cut,
+                            f"quarantined {led.torn_tail_bytes} bytes, "
+                            f"expected {cut}",
+                        )
+                elif os.path.exists(sidecar):
+                    raise CrashpointViolation(
+                        self.name, "torn_tail", cut,
+                        "clean-boundary recovery produced a sidecar",
+                    )
+            finally:
+                led.close()
+
+    def run_matrix(self, stride: int = 1) -> Dict[str, Any]:
+        from deequ_tpu.obs.registry import CRASHPOINTS_SURVIVED
+
+        base, frame = self._materialize()
+        cuts = list(range(0, len(frame) + 1, max(int(stride), 1)))
+        if cuts[-1] != len(frame):
+            cuts.append(len(frame))
+        for cut in cuts:
+            self.run_cell(base, frame, cut)
+            CRASHPOINTS_SURVIVED.inc()
+        return {
+            "write_len": len(frame),
+            "cells": len(cuts),
+            "by_seam": {"torn_tail": len(cuts)},
+        }
+
+
+def default_adapters() -> List[Any]:
+    return [
+        RequestLedgerAdapter(),
+        RepositorySegmentAdapter(),
+        ControlRegistryAdapter(),
+        StreamCheckpointAdapter(),
+    ]
+
+
+def run_crashpoint_matrix(
+    adapters: Optional[List[Any]] = None, stride: int = 1
+) -> Dict[str, Any]:
+    """Sweep every write seam at every byte boundary (``stride`` > 1
+    subsamples the grid for quick runs; the healthy full-length cell is
+    always included) across every durable store. Raises
+    ``CrashpointViolation`` on the first broken cell; returns a per-
+    store summary. Runs with retries disabled (single attempt) so the
+    UNretried recovery paths are what is being asserted."""
+    adapters = default_adapters() if adapters is None else adapters
+    previous = default_retry_policy()
+    set_default_retry_policy(ONE_SHOT_RETRY)
+    try:
+        stores = {a.name: a.run_matrix(stride=stride) for a in adapters}
+    finally:
+        set_default_retry_policy(previous)
+        _mount(None)
+    return {
+        "stores": stores,
+        "cells": sum(s["cells"] for s in stores.values()),
+        "survived": sum(s["cells"] for s in stores.values()),
+    }

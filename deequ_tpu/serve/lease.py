@@ -1,0 +1,227 @@
+"""Durable coordinator lease with a monotonically increasing EPOCH —
+the fencing token that makes split brain safe (PR 18).
+
+PR 17 made coordinator death survivable: a fresh
+:class:`~deequ_tpu.serve.pfleet.ProcessFleet` on the same ``ledger_dir``
+replays outstanding accepts onto the original futures. But "dead" was an
+assumption — a coordinator that merely STALLED (GC pause, partition,
+stopped container) through a takeover wakes up as a zombie writing to
+the same ledger and re-dispatching the same work. This module is the
+standard fencing answer: a durable lease file whose ``epoch`` only ever
+increases. Every acquisition (including a resume takeover) bumps the
+epoch; every frame, ledger record, and result the coordinator writes
+carries it; anything stamped with an older epoch is refused typed
+(:class:`~deequ_tpu.exceptions.StaleEpochException`) or ignored.
+
+The lease file is itself durable state under the same discipline it
+protects: written via the atomic temp+fsync+rename helper inside the
+checksummed ``DQX1`` envelope, with torn-lease reads surfacing typed
+(or quarantining to a counter-suffixed ``.corrupt`` sidecar in recover
+mode). A torn lease can therefore never silently REGRESS the epoch:
+callers that must not move backwards pass the request ledger's
+``max_epoch()`` as ``min_epoch`` at acquire, so the fencing floor
+survives even a destroyed lease file.
+
+The TTL is a liveness knob, not the safety mechanism: ``check()`` (the
+coordinator's hot-path guard) re-reads the lease from disk on every
+call — cheap against the fsync every durable accept already pays — and
+re-asserts/renews it at half-TTL cadence. Safety is the epoch ordering
+alone; two coordinators that both believe they hold the lease still
+cannot double-resolve, because the lower epoch loses every comparison.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from deequ_tpu.data.fs import FileSystem, LocalFileSystem
+from deequ_tpu.exceptions import CorruptStateException, StaleEpochException
+from deequ_tpu.resilience.atomic import (
+    atomic_write_bytes,
+    quarantine_path,
+    unwrap_checksum,
+    wrap_checksum,
+)
+
+#: the one lease file inside a fleet's lease_dir
+LEASE_FILENAME = "coordinator.lease"
+
+
+@dataclass(frozen=True)
+class LeaseState:
+    """One decoded lease file: the cluster's current fencing state."""
+
+    epoch: int
+    holder: str
+    acquired_wall: float
+    renewed_wall: float
+    ttl_s: float
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else time.time()) - self.renewed_wall
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """Past its TTL — a takeover is POLITE now (the holder stopped
+        renewing), though epoch fencing keeps any takeover safe."""
+        return self.age_s(now) > self.ttl_s
+
+
+class CoordinatorLease:
+    """The durable epoch-fenced lease (see module doc).
+
+    ``fs`` is injectable (tests run the full protocol over an
+    :class:`~deequ_tpu.data.fs.InMemoryFileSystem`); production uses
+    local disk, where the atomic-rename write gives lease updates
+    all-or-nothing visibility."""
+
+    def __init__(self, lease_dir: str, ttl: Optional[float] = None,
+                 holder: Optional[str] = None,
+                 fs: Optional[FileSystem] = None):
+        from deequ_tpu.envcfg import env_value
+
+        self._fs = fs if fs is not None else LocalFileSystem()
+        self.lease_dir = lease_dir
+        self._fs.makedirs(lease_dir)
+        self.path = self._fs.join(lease_dir, LEASE_FILENAME)
+        self.ttl = float(
+            ttl if ttl is not None else env_value("DEEQU_TPU_LEASE_TTL")
+        )
+        if self.ttl <= 0:
+            raise ValueError("lease ttl must be > 0 seconds")
+        self.holder = holder or f"{socket.gethostname()}:pid{os.getpid()}"
+        #: this holder's epoch; 0 = not acquired
+        self.epoch = 0
+        self._last_renew = 0.0  # monotonic stamp of our last disk write
+        self._acquired_wall = 0.0
+
+    # -- disk format -----------------------------------------------------
+
+    def read(self, recover: bool = False) -> Optional[LeaseState]:
+        """Decode the on-disk lease. None when no lease exists; typed
+        :class:`CorruptStateException` on a torn/damaged lease file —
+        unless ``recover`` is set, which quarantines the damaged bytes
+        to a counter-suffixed ``.corrupt`` sidecar (forensic evidence;
+        a second recovery never overwrites the first) and returns None
+        so the caller re-acquires. The epoch floor against regression
+        after a destroyed lease is the caller's ``min_epoch``."""
+        if not self._fs.exists(self.path):
+            return None
+        with self._fs.open(self.path, "rb") as f:
+            raw = f.read()
+        try:
+            payload = unwrap_checksum(raw, "coordinator lease")
+            state = json.loads(payload.decode("utf-8"))
+            return LeaseState(
+                epoch=int(state["epoch"]),
+                holder=str(state.get("holder", "")),
+                acquired_wall=float(state.get("acquired_wall", 0.0)),
+                renewed_wall=float(state.get("renewed_wall", 0.0)),
+                ttl_s=float(state.get("ttl_s", self.ttl)),
+            )
+        except CorruptStateException as e:
+            if not recover:
+                raise
+            self._quarantine(raw, str(e))
+            return None
+        except (KeyError, TypeError, ValueError, UnicodeDecodeError) as e:
+            # checksum passed (or legacy passthrough) but the payload
+            # does not decode as a lease: same damage classification
+            damage = CorruptStateException(
+                "coordinator lease", f"undecodable lease payload: {e}"
+            )
+            if not recover:
+                raise damage from e
+            self._quarantine(raw, str(damage))
+            return None
+
+    def _quarantine(self, raw: bytes, error: str) -> None:
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+        sidecar = quarantine_path(self._fs, self.path)
+        with self._fs.open(sidecar, "wb") as f:  # deequ-lint: ignore[durable-write] -- quarantine sidecar: forensic copy of already-damaged bytes, not durable state (no reader validates it)
+            f.write(raw)
+        self._fs.delete(self.path)
+        SCAN_STATS.record_degradation(
+            "lease_torn", path=self.path, sidecar=sidecar, error=error,
+        )
+
+    def _write(self, epoch: int, acquired_wall: float) -> None:
+        now = time.time()
+        payload = json.dumps({
+            "epoch": epoch,
+            "holder": self.holder,
+            "acquired_wall": acquired_wall,
+            "renewed_wall": now,
+            "ttl_s": self.ttl,
+        }, sort_keys=True).encode("utf-8")
+        atomic_write_bytes(
+            self._fs, self.path, wrap_checksum(payload),
+            what="coordinator lease",
+        )
+        self._acquired_wall = acquired_wall
+        self._last_renew = time.monotonic()
+
+    # -- the protocol ----------------------------------------------------
+
+    def acquire(self, min_epoch: int = 0) -> int:
+        """Take (over) the lease: the new epoch strictly exceeds every
+        epoch ever observed — the stored lease's, ``min_epoch`` (pass
+        the request ledger's ``max_epoch()`` so a torn/lost lease file
+        cannot regress the fence), and our own. Acquisition does not
+        wait for expiry: the caller decided a takeover is warranted
+        (resume after coordinator death); epoch ordering keeps the
+        stalled previous holder harmless."""
+        current = self.read(recover=True)
+        floor = max(
+            current.epoch if current is not None else 0,
+            int(min_epoch), self.epoch,
+        )
+        self.epoch = floor + 1
+        self._write(self.epoch, acquired_wall=time.time())
+        return self.epoch
+
+    def check(self) -> int:
+        """The hot-path fencing guard (every fenced submit): raise
+        :class:`StaleEpochException` when the on-disk lease outranks our
+        epoch — a successor took over while we stalled. Re-asserts the
+        lease when the file is missing/damaged (our epoch stands until
+        someone outranks it) and renews it at half-TTL cadence."""
+        if self.epoch <= 0:
+            raise ValueError("check() before acquire()")
+        current = self.read(recover=True)
+        if current is not None and current.epoch > self.epoch:
+            raise StaleEpochException(
+                f"lease epoch {self.epoch} fenced out: "
+                f"{current.holder!r} holds epoch {current.epoch}",
+                stale_epoch=self.epoch,
+                current_epoch=current.epoch,
+                holder=current.holder,
+            )
+        if current is None or current.epoch < self.epoch:
+            # lost/damaged/regressed lease file: re-assert ours
+            self._write(self.epoch, acquired_wall=self._acquired_wall)
+        elif time.monotonic() - self._last_renew > self.ttl / 2.0:
+            self.renew()
+        return self.epoch
+
+    def renew(self) -> None:
+        """Refresh ``renewed_wall`` (the TTL heartbeat). Fenced holders
+        must not renew over their successor: re-checks the disk epoch
+        first."""
+        if self.epoch <= 0:
+            raise ValueError("renew() before acquire()")
+        current = self.read(recover=True)
+        if current is not None and current.epoch > self.epoch:
+            raise StaleEpochException(
+                f"renew refused: lease epoch {self.epoch} fenced out by "
+                f"{current.holder!r} at epoch {current.epoch}",
+                stale_epoch=self.epoch,
+                current_epoch=current.epoch,
+                holder=current.holder,
+            )
+        self._write(self.epoch, acquired_wall=self._acquired_wall)

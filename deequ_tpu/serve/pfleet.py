@@ -42,10 +42,25 @@ worker process per host/chip):
   wall-clock the coordinator spent dead; an expired victim is shed
   typed, never replayed stale.
 
+- **Epoch fencing** (:mod:`deequ_tpu.serve.lease`) — resume assumed the
+  old coordinator was DEAD; fencing makes a merely-stalled one
+  harmless. When fencing is on (default whenever a ``ledger_dir`` is
+  configured), the coordinator acquires a durable lease whose epoch
+  strictly exceeds everything the ledger has witnessed, stamps every
+  submit frame, ledger record, and reaccept with it, and checks the
+  lease on every submit: a zombie that wakes after a takeover raises
+  :class:`~deequ_tpu.exceptions.StaleEpochException` on its next
+  submit, permanently, and IGNORES result frames once fenced (counted
+  on ``zombie_results_ignored``). Workers refuse stale-epoch dispatches
+  typed before any side effect; ledger replay reconciles cross-epoch
+  duplicates by epoch precedence — exactly-once stays the futures'
+  first-resolution-wins gate, now with the zombie unable to add new
+  effects at all.
+
 Chaos seams: :meth:`kill_worker` (real SIGKILL),
-:meth:`rejoin_worker`, and ledger-backed resume — scripted by
-``resilience/chaos.py``'s ``kill9`` / ``coord_kill9`` events under the
-fleet oracles.
+:meth:`rejoin_worker`, ledger-backed resume, and the zombie-coordinator
+``partition`` seam — scripted by ``resilience/chaos.py``'s ``kill9`` /
+``coord_kill9`` / ``partition`` events under the fleet oracles.
 """
 
 from __future__ import annotations
@@ -67,9 +82,11 @@ from deequ_tpu.exceptions import (
     DeadlineExceededException,
     ServiceClosedException,
     ServiceOverloadedException,
+    StaleEpochException,
     WorkerLostException,
 )
 from deequ_tpu.serve.admission import Slo, resolve_slo
+from deequ_tpu.serve.lease import CoordinatorLease
 from deequ_tpu.serve.ledger import RequestLedger
 from deequ_tpu.serve.membership import FleetMembership
 from deequ_tpu.serve.router import ConsistentHashRouter, route_digest
@@ -107,6 +124,9 @@ class ProcessFleetConfig:
     worker_knobs: Optional[Dict[str, Any]] = None
     ack_timeout: float = 10.0
     spawn_timeout: float = 60.0
+    lease_dir: Optional[str] = None
+    lease_ttl: Optional[float] = None
+    fencing: Optional[bool] = None
 
     def __post_init__(self):
         from deequ_tpu.envcfg import env_value
@@ -147,6 +167,30 @@ class ProcessFleetConfig:
         if self.ack_timeout <= 0:
             raise ValueError("ack_timeout must be > 0 seconds")
         self.worker_knobs = dict(self.worker_knobs or {})
+        if self.lease_dir is None:
+            self.lease_dir = env_value("DEEQU_TPU_LEASE_DIR")
+        if self.lease_dir is None:
+            # the natural home: the lease fences the same durable state
+            # the ledger holds
+            self.lease_dir = self.ledger_dir
+        if self.lease_ttl is None:
+            self.lease_ttl = env_value("DEEQU_TPU_LEASE_TTL")
+        self.lease_ttl = float(self.lease_ttl)
+        if self.lease_ttl <= 0:
+            raise ValueError("lease_ttl must be > 0 seconds")
+        if self.fencing is None:
+            self.fencing = env_value("DEEQU_TPU_FENCING")
+        if self.fencing is None:
+            # default ON exactly when there is durable state to fence
+            self.fencing = (
+                self.ledger_dir is not None and self.lease_dir is not None
+            )
+        self.fencing = bool(self.fencing)
+        if self.fencing and not self.lease_dir:
+            raise ValueError(
+                "fencing requires a lease_dir (or a ledger_dir to "
+                "default it from)"
+            )
 
 
 class _Ack:
@@ -217,7 +261,12 @@ _ACTIVE_PFLEET: Optional[weakref.ReferenceType] = None
 
 
 def _pfleet_section() -> dict:
-    from deequ_tpu.obs.registry import LEDGER_APPENDS, PFLEET_REDISPATCHES
+    from deequ_tpu.obs.registry import (
+        FENCING_REJECTIONS,
+        LEDGER_APPENDS,
+        PFLEET_REDISPATCHES,
+        ZOMBIE_RESULTS_IGNORED,
+    )
 
     fleet = _ACTIVE_PFLEET() if _ACTIVE_PFLEET is not None else None
     if fleet is None:
@@ -225,6 +274,8 @@ def _pfleet_section() -> dict:
             "workers_alive": 0,
             "redispatches": PFLEET_REDISPATCHES.value,
             "ledger_appends": LEDGER_APPENDS.value,
+            "fencing_rejections": FENCING_REJECTIONS.value,
+            "zombie_results_ignored": ZOMBIE_RESULTS_IGNORED.value,
         }
     return fleet._section()
 
@@ -271,6 +322,23 @@ class ProcessFleet:
         if self.config.ledger_dir:
             self._ledger = RequestLedger(
                 self.config.ledger_dir, mode=self.config.ledger_mode
+            )
+        #: epoch fencing (serve/lease.py): acquire strictly above both
+        #: the stored lease AND everything the ledger has witnessed, so
+        #: a takeover outranks the previous holder even if the lease
+        #: file itself was destroyed. 0 = fencing off.
+        self._lease: Optional[CoordinatorLease] = None
+        self._fenced: Optional[StaleEpochException] = None
+        self.epoch = 0
+        if self.config.fencing and self.config.lease_dir:
+            self._lease = CoordinatorLease(
+                self.config.lease_dir, ttl=self.config.lease_ttl
+            )
+            self.epoch = self._lease.acquire(
+                min_epoch=(
+                    self._ledger.max_epoch()
+                    if self._ledger is not None else 0
+                )
             )
         self.membership = FleetMembership(
             members=self._alive_ids,
@@ -428,6 +496,27 @@ class ProcessFleet:
 
     def _on_result(self, msg: dict) -> None:
         accept_id = str(msg.get("id"))
+        frame_epoch = int(msg.get("epoch") or 0)
+        if self._lease is not None and (
+            self._fenced is not None
+            or (frame_epoch and frame_epoch < self.epoch)
+        ):
+            # a fenced-out coordinator must add NO effects — its
+            # successor re-dispatched this work and owns its resolution
+            # (the futures' gate would keep exactly-once regardless;
+            # ignoring keeps the zombie's effect count at zero) — and a
+            # result stamped with a predecessor's epoch is a zombie
+            # worker's late echo
+            from deequ_tpu.obs.registry import ZOMBIE_RESULTS_IGNORED
+            from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+            ZOMBIE_RESULTS_IGNORED.inc()
+            SCAN_STATS.record_degradation(
+                "zombie_result_ignored", id=accept_id,
+                frame_epoch=frame_epoch, epoch=self.epoch,
+                fenced=self._fenced is not None,
+            )
+            return
         with self._lock:
             asg = self._assignments.get(accept_id)
         if asg is None:
@@ -461,7 +550,8 @@ class ProcessFleet:
                 popped = self._assignments.pop(accept_id, None)
             if popped is not None and self._ledger is not None:
                 try:
-                    self._ledger.append_resolve(accept_id)
+                    self._ledger.append_resolve(accept_id,
+                                                epoch=self.epoch)
                 except (OSError, ValueError):
                     # a tombstone lost to a closing/full ledger costs
                     # one redundant (gated) replay at resume, never a
@@ -515,6 +605,46 @@ class ProcessFleet:
             return False, 0.0
         return worker.process_alive(), worker.last_pong
 
+    # -- fencing ---------------------------------------------------------
+
+    def _fence(self, cause: StaleEpochException) -> None:
+        """Fence PERMANENTLY: a coordinator that has been outranked once
+        stays outranked (un-fencing would re-open split brain). Every
+        subsequent submit re-raises typed from the stored cause."""
+        if self._fenced is not None:
+            return
+        self._fenced = cause
+        from deequ_tpu.obs.registry import FENCING_REJECTIONS
+        from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+        FENCING_REJECTIONS.inc()
+        SCAN_STATS.record_degradation(
+            "stale_epoch_fenced", epoch=self.epoch,
+            current_epoch=cause.current_epoch, holder=cause.holder,
+        )
+
+    def _check_fence(self) -> None:
+        """The per-submit fencing guard: re-read the lease (cheap next
+        to the fsync every durable accept pays) and refuse typed when a
+        successor outranks us. No-op when fencing is off."""
+        if self._lease is None:
+            return
+        if self._fenced is not None:
+            from deequ_tpu.obs.registry import FENCING_REJECTIONS
+
+            FENCING_REJECTIONS.inc()
+            raise StaleEpochException(
+                str(self._fenced),
+                stale_epoch=self._fenced.stale_epoch,
+                current_epoch=self._fenced.current_epoch,
+                holder=self._fenced.holder,
+            )
+        try:
+            self._lease.check()
+        except StaleEpochException as e:
+            self._fence(e)
+            raise
+
     # -- submission ------------------------------------------------------
 
     def route(self, data, checks: Sequence = (),
@@ -534,7 +664,10 @@ class ProcessFleet:
         killed at any later instant still owes (and can replay) exactly
         this request. Overload spill walks the ring exactly like the
         in-process fleet — every refusal is the worker's own typed
-        backpressure, reconstructed from the wire."""
+        backpressure, reconstructed from the wire. When fencing is on,
+        a fenced-out (zombie) coordinator refuses here typed
+        (:class:`StaleEpochException`) before any side effect."""
+        self._check_fence()
         analyzers = list(required_analyzers)
         for check in checks:
             analyzers.extend(check.required_analyzers())
@@ -586,6 +719,7 @@ class ProcessFleet:
                     work=(data, tuple(checks),
                           tuple(required_analyzers)),
                     quarantine=self._tenant_health.snapshot(),
+                    epoch=self.epoch,
                 )
             status, outcome = self._offer_walk(asg)
             if status == "accepted":
@@ -595,7 +729,8 @@ class ProcessFleet:
             with self._lock:
                 self._assignments.pop(asg.accept_id, None)
             if self._ledger is not None:
-                self._ledger.append_resolve(asg.accept_id)
+                self._ledger.append_resolve(asg.accept_id,
+                                            epoch=self.epoch)
             if status == "refused":
                 raise outcome
             raise ServiceClosedException(
@@ -624,6 +759,12 @@ class ProcessFleet:
             if outcome == "accept":
                 asg.worker = wid
                 return "accepted", wid
+            if isinstance(outcome, StaleEpochException):
+                # a WORKER fenced us: our epoch is stale for every
+                # worker, not just this one — stop the walk, fence
+                # permanently
+                self._fence(outcome)
+                return "refused", outcome
             if isinstance(outcome, ServiceOverloadedException):
                 if refusal is None:
                     refusal = outcome
@@ -642,6 +783,7 @@ class ProcessFleet:
         frame = {
             "t": "submit",
             "id": asg.accept_id,
+            "epoch": self.epoch,
             "work_blob": asg.work_blob,
             "tenant_blob": asg.tenant_blob,
             "slo": {"cls": asg.slo.cls, "weight": asg.slo.weight,
@@ -692,6 +834,13 @@ class ProcessFleet:
         message = fields.get("message") or "worker refused admission"
         if cls == "ServiceClosedException":
             return ServiceClosedException(message)
+        if cls == "StaleEpochException":
+            return StaleEpochException(
+                message,
+                stale_epoch=fields.get("stale_epoch"),
+                current_epoch=fields.get("current_epoch"),
+                holder=fields.get("holder"),
+            )
         kw = dict(
             queue_depth=fields.get("queue_depth"),
             retry_after_s=fields.get("retry_after_s"),
@@ -978,6 +1127,14 @@ class ProcessFleet:
                 with self._lock:
                     self._assignments[accept_id] = asg
                     self._record_heat(asg.digest, data, analyzers)
+                if self.epoch and (
+                    RequestLedger._epoch_of(rec) < self.epoch
+                ):
+                    # durable ownership claim BEFORE re-dispatch: the
+                    # record's effective epoch becomes ours, so the
+                    # zombie that accepted it loses every epoch-
+                    # precedence comparison from here on
+                    self._ledger.append_reaccept(accept_id, self.epoch)
                 PFLEET_RESUMED.inc()
                 self.resumed[accept_id] = future
                 if left is not None and left <= 0:
@@ -1065,7 +1222,11 @@ class ProcessFleet:
         )
 
     def _section(self) -> dict:
-        from deequ_tpu.obs.registry import LEDGER_APPENDS
+        from deequ_tpu.obs.registry import (
+            FENCING_REJECTIONS,
+            LEDGER_APPENDS,
+            ZOMBIE_RESULTS_IGNORED,
+        )
 
         with self._lock:
             workers = {
@@ -1091,6 +1252,11 @@ class ProcessFleet:
             "redispatches": self.requests_redispatched,
             "requests_outstanding": pending,
             "resumed": len(self.resumed),
+            "epoch": self.epoch,
+            "fencing": self._lease is not None,
+            "fenced": self._fenced is not None,
+            "fencing_rejections": FENCING_REJECTIONS.value,
+            "zombie_results_ignored": ZOMBIE_RESULTS_IGNORED.value,
             "ledger_appends": LEDGER_APPENDS.value,
             "ledger_path": (
                 self._ledger.path if self._ledger is not None else None

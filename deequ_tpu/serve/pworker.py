@@ -50,6 +50,7 @@ from typing import Any, Dict, Optional
 from deequ_tpu.exceptions import (
     ServiceClosedException,
     ServiceOverloadedException,
+    StaleEpochException,
 )
 from deequ_tpu.serve.transport import (
     Transport,
@@ -190,14 +191,20 @@ def replay_fingerprints(service, plans) -> int:
     return warmed
 
 
-def _refusal_fields(e: ServiceOverloadedException) -> dict:
+def _refusal_fields(e) -> dict:
+    """Decompose a typed refusal (the ServiceOverloadedException family
+    OR a fencing StaleEpochException) into structured wire fields — the
+    coordinator reconstructs the same type from them."""
     return {
         "cls": type(e).__name__,
         "message": str(e),
-        "queue_depth": e.queue_depth,
-        "retry_after_s": e.retry_after_s,
-        "slo_class": e.slo_class,
+        "queue_depth": getattr(e, "queue_depth", None),
+        "retry_after_s": getattr(e, "retry_after_s", None),
+        "slo_class": getattr(e, "slo_class", None),
         "reason": getattr(e, "reason", None),
+        "stale_epoch": getattr(e, "stale_epoch", None),
+        "current_epoch": getattr(e, "current_epoch", None),
+        "holder": getattr(e, "holder", None),
     }
 
 
@@ -224,6 +231,13 @@ class WorkerLoop:
                     start=True,
                 )
         self._stopping = False
+        #: epoch fencing (serve/lease.py): the highest coordinator
+        #: epoch this worker has witnessed; dispatches stamped older
+        #: are refused typed before ANY side effect. 0 = unfenced.
+        self._highest_epoch = 0
+        #: accept_id -> the epoch that dispatched it, echoed on results
+        #: so a resumed coordinator can spot zombie-epoch result frames
+        self._accept_epochs: Dict[str, int] = {}
 
     # -- frame handlers --------------------------------------------------
 
@@ -248,6 +262,9 @@ class WorkerLoop:
             "t": "result",
             "id": accept_id,
             "ok": bool(ok),
+            "epoch": self._accept_epochs.pop(
+                accept_id, self._highest_epoch
+            ),
             "payload_blob": dump_blob(payload),
             "quarantine_blob": self._quarantine_blob(),
         })
@@ -256,6 +273,23 @@ class WorkerLoop:
         from deequ_tpu.serve.admission import Slo
 
         accept_id = str(msg["id"])
+        epoch = int(msg.get("epoch") or 0)
+        if epoch and epoch < self._highest_epoch:
+            # a fenced-out (zombie) coordinator's dispatch: refuse it
+            # typed BEFORE any side effect — no quarantine restore, no
+            # blob decode, no admission
+            exc = StaleEpochException(
+                f"dispatch from stale epoch {epoch} refused: worker "
+                f"{self.idx} has seen epoch {self._highest_epoch}",
+                stale_epoch=epoch,
+                current_epoch=self._highest_epoch,
+            )
+            self._send({"t": "refuse", "id": accept_id,
+                        **_refusal_fields(exc)})
+            return
+        if epoch:
+            self._highest_epoch = epoch
+            self._accept_epochs[accept_id] = epoch
         snap_blob = msg.get("quarantine_blob")
         if snap_blob:
             self.service.tenant_health.restore(

@@ -1,0 +1,400 @@
+"""Split-brain fencing suite (serve/lease.py + the epoch plumbing,
+round 18) — tier-1 `pfleet`.
+
+Contracts pinned here:
+
+- LEASE DURABILITY: the coordinator lease is checksummed + atomically
+  replaced; a lease file torn at ANY byte boundary reads as typed
+  ``CorruptStateException`` — and in recover mode quarantines to a
+  counter-suffixed ``.corrupt`` sidecar (a second recovery never
+  overwrites the first's evidence) and re-acquires;
+- EPOCH MONOTONICITY: every acquisition strictly exceeds every epoch
+  ever observed — the stored lease's, the caller's ``min_epoch`` (the
+  request ledger's ``max_epoch()``), and the holder's own — so even a
+  DESTROYED lease file cannot regress the fence;
+- TYPED FENCING END TO END: a fenced-out holder's ``check()``/
+  ``renew()`` raise ``StaleEpochException`` with structured fields
+  (stale_epoch / current_epoch / holder) that survive the wire frame
+  round-trip and reconstruct the same type coordinator-side; a worker
+  refuses a stale-epoch dispatch typed BEFORE any side effect;
+- CROSS-EPOCH EXACTLY-ONCE: duplicate ledger accepts reconcile to the
+  highest epoch, ``reaccept`` re-stamps ownership without re-pickling,
+  stale tombstones still settle (counted); two live coordinators on
+  one ledger resolve every request exactly once, bit-identical to a
+  healthy serial run, with the zombie fenced typed.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deequ_tpu
+from deequ_tpu import VerificationSuite
+from deequ_tpu.analyzers import Completeness, Mean, Size, Sum
+from deequ_tpu.data.fs import InMemoryFileSystem
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.exceptions import (
+    CorruptStateException,
+    StaleEpochException,
+)
+from deequ_tpu.parallel.mesh import use_mesh
+from deequ_tpu.resilience.atomic import quarantine_path
+from deequ_tpu.serve.ledger import RequestLedger
+from deequ_tpu.serve.lease import (
+    LEASE_FILENAME,
+    CoordinatorLease,
+)
+from deequ_tpu.serve.pfleet import ProcessFleet
+from deequ_tpu.serve.pworker import WorkerLoop, _refusal_fields
+from deequ_tpu.serve.transport import (
+    LoopbackTransport,
+    decode_frame,
+    encode_frame,
+)
+
+pytestmark = pytest.mark.pfleet
+
+
+def _table(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    return ColumnarTable([
+        Column("x", DType.FRACTIONAL, values=r.normal(100, 5, n),
+               mask=r.random(n) > 0.05),
+        Column("i", DType.INTEGRAL,
+               values=r.integers(0, 50, n).astype(np.float64),
+               mask=np.ones(n, bool)),
+    ])
+
+
+def _analyzers():
+    return [Size(), Completeness("x"), Mean("x"), Sum("i")]
+
+
+def _bits(value):
+    import struct
+
+    if isinstance(value, float):
+        return struct.pack("<d", value)
+    return value
+
+
+def _assert_bit_identical(serial_result, served_result, label=""):
+    assert serial_result.status == served_result.status, label
+    for a, m1 in serial_result.metrics.items():
+        m2 = served_result.metrics[a]
+        assert m1.value.is_success == m2.value.is_success, (label, str(a))
+        if m1.value.is_success:
+            assert _bits(m1.value.get()) == _bits(m2.value.get()), (
+                f"{label}: {a} serial={m1.value.get()!r} "
+                f"fleet={m2.value.get()!r}"
+            )
+
+
+def _loopback_fleet(**kw):
+    kw.setdefault("transport", "loopback")
+    kw.setdefault("n_workers", 2)
+    kw.setdefault("monitor", False)
+    kw.setdefault("worker_knobs", {"coalesce_window": 0.0})
+    return ProcessFleet(**kw)
+
+
+def _lease(fs, ttl=30.0, holder=None):
+    return CoordinatorLease("lease", ttl=ttl, holder=holder, fs=fs)
+
+
+# -- the lease protocol ------------------------------------------------------
+
+
+def test_acquire_bumps_epoch_monotonically():
+    fs = InMemoryFileSystem()
+    a, b = _lease(fs, holder="a"), _lease(fs, holder="b")
+    assert a.acquire() == 1
+    assert b.acquire() == 2
+    # a third holder over the same file keeps climbing
+    assert _lease(fs, holder="c").acquire() == 3
+
+
+def test_acquire_respects_min_epoch_floor():
+    fs = InMemoryFileSystem()
+    lease = _lease(fs)
+    # a fresh directory with a ledger floor (max_epoch) of 7: the new
+    # epoch must outrank everything the ledger ever witnessed
+    assert lease.acquire(min_epoch=7) == 8
+
+
+def test_check_fences_stale_holder_typed():
+    fs = InMemoryFileSystem()
+    a, b = _lease(fs, holder="host-a"), _lease(fs, holder="host-b")
+    a.acquire()
+    assert a.check() == 1  # unchallenged: check re-reads and stands
+    b.acquire()
+    with pytest.raises(StaleEpochException) as ei:
+        a.check()
+    assert ei.value.stale_epoch == 1
+    assert ei.value.current_epoch == 2
+    assert ei.value.holder == "host-b"
+    # fencing also blocks the renew heartbeat
+    with pytest.raises(StaleEpochException):
+        a.renew()
+    # the winner keeps passing
+    assert b.check() == 2
+
+
+def test_check_reasserts_lost_lease_file():
+    fs = InMemoryFileSystem()
+    lease = _lease(fs)
+    lease.acquire()
+    fs.delete(lease.path)
+    # a lost lease file does not fence the holder: the epoch stands and
+    # the file is re-asserted
+    assert lease.check() == 1
+    assert fs.exists(lease.path)
+
+
+def test_check_before_acquire_is_an_error():
+    lease = _lease(InMemoryFileSystem())
+    with pytest.raises(ValueError):
+        lease.check()
+
+
+# -- torn-lease recovery -----------------------------------------------------
+
+
+def test_lease_torn_at_every_byte_is_typed():
+    """A lease file cut at ANY byte below its full length must surface
+    typed CorruptStateException — never garbage, never a silent epoch."""
+    fs = InMemoryFileSystem()
+    lease = _lease(fs)
+    lease.acquire()
+    whole = fs.files[lease.path]
+    for cut in range(len(whole)):
+        fs.files[lease.path] = whole[:cut]
+        with pytest.raises(CorruptStateException):
+            _lease(fs).read()
+    # the un-torn file still decodes
+    fs.files[lease.path] = whole
+    state = _lease(fs).read()
+    assert state is not None and state.epoch == 1
+
+
+def test_torn_lease_recovery_quarantines_without_sidecar_collision():
+    fs = InMemoryFileSystem()
+    lease = _lease(fs)
+    lease.acquire()
+    whole = fs.files[lease.path]
+
+    # first tear: recover quarantines + deletes the lease
+    fs.files[lease.path] = whole[: len(whole) // 2]
+    assert _lease(fs).read(recover=True) is None
+    assert not fs.exists(lease.path)
+    assert fs.exists(lease.path + ".corrupt")
+
+    # second tear in the same directory: the sidecar name must NOT
+    # overwrite the first recovery's evidence
+    fs.files[lease.path] = whole[:7]
+    assert _lease(fs).read(recover=True) is None
+    assert fs.exists(lease.path + ".corrupt")
+    assert fs.exists(lease.path + ".corrupt.1")
+    assert fs.files[lease.path + ".corrupt"] == whole[: len(whole) // 2]
+    assert fs.files[lease.path + ".corrupt.1"] == whole[:7]
+
+
+def test_torn_lease_cannot_regress_epoch_with_ledger_floor():
+    fs = InMemoryFileSystem()
+    a, b = _lease(fs, holder="a"), _lease(fs, holder="b")
+    a.acquire()
+    b.acquire()  # epoch 2 on disk
+    # the lease file is destroyed; a fresh holder passing the ledger's
+    # max_epoch as the floor still outranks everything ever issued
+    fs.delete(b.path)
+    c = _lease(fs, holder="c")
+    assert c.acquire(min_epoch=2) == 3
+
+
+def test_quarantine_path_counter_suffix():
+    fs = InMemoryFileSystem()
+    assert quarantine_path(fs, "d/f") == "d/f.corrupt"
+    fs.files["d/f.corrupt"] = b"x"
+    assert quarantine_path(fs, "d/f") == "d/f.corrupt.1"
+    fs.files["d/f.corrupt.1"] = b"y"
+    assert quarantine_path(fs, "d/f") == "d/f.corrupt.2"
+
+
+def test_quarantine_path_raw_os(tmp_path):
+    target = str(tmp_path / "state.bin")
+    assert quarantine_path(None, target) == target + ".corrupt"
+    with open(target + ".corrupt", "wb") as f:
+        f.write(b"evidence")
+    assert quarantine_path(None, target) == target + ".corrupt.1"
+
+
+# -- StaleEpochException over the wire ---------------------------------------
+
+
+def test_stale_epoch_refusal_wire_roundtrip():
+    exc = StaleEpochException(
+        "dispatch from stale epoch 3 refused",
+        stale_epoch=3, current_epoch=7, holder="host-b:pid99",
+    )
+    frame = encode_frame({"t": "refuse", "id": "x" * 32,
+                          **_refusal_fields(exc)})
+    fields = decode_frame(frame)
+    rebuilt = ProcessFleet._rebuild_refusal(fields)
+    assert type(rebuilt) is StaleEpochException
+    assert rebuilt.stale_epoch == 3
+    assert rebuilt.current_epoch == 7
+    assert rebuilt.holder == "host-b:pid99"
+    assert "stale epoch 3" in str(rebuilt)
+
+
+def test_worker_refuses_stale_epoch_dispatch_before_any_side_effect():
+    coord_end, worker_end = LoopbackTransport.pair()
+    # the epoch gate runs before ANY service interaction — a dummy
+    # service object proves no side effect happens on the refusal path
+    loop = WorkerLoop(worker_end, idx=3, service=object())
+    loop._highest_epoch = 5
+    loop._on_submit({"id": "z" * 32, "epoch": 3})
+    msg = coord_end.recv(timeout=5.0)
+    assert msg is not None
+    assert msg["t"] == "refuse"
+    assert msg["cls"] == "StaleEpochException"
+    assert msg["stale_epoch"] == 3
+    assert msg["current_epoch"] == 5
+
+
+# -- cross-epoch ledger reconciliation ---------------------------------------
+
+
+def _accept(led, accept_id, epoch):
+    led.append_accept(
+        accept_id, tenant=f"t-{accept_id}", digest=f"d-{accept_id}",
+        slo_cls="standard", deadline_ms=None, weight=1.0,
+        deadline_left_s=None, work=("data", (), ()), epoch=epoch,
+    )
+
+
+def test_ledger_cross_epoch_reconciliation(tmp_path):
+    led = RequestLedger(str(tmp_path))
+    _accept(led, "a", 1)
+    _accept(led, "a", 3)      # duplicate accept, newer epoch wins
+    _accept(led, "b", 2)
+    led.append_reaccept("b", 4)   # resume takeover re-stamps ownership
+    led.append_reaccept("b", 2)   # stale reaccept must NOT regress it
+    _accept(led, "c", 5)
+    led.append_resolve("c", epoch=2)  # stale tombstone still settles
+    out = led.outstanding()
+    assert set(out) == {"a", "b"}
+    assert out["a"]["epoch"] == 3
+    assert out["b"]["epoch"] == 4
+    assert led.cross_epoch_duplicates == 1
+    assert led.cross_epoch_reaccepts == 1
+    assert led.stale_tombstones == 1
+    assert led.max_epoch() == 5
+    led.close()
+
+    # replay from disk reconciles identically
+    led2 = RequestLedger(str(tmp_path))
+    out2 = led2.outstanding()
+    assert set(out2) == {"a", "b"}
+    assert out2["a"]["epoch"] == 3 and out2["b"]["epoch"] == 4
+    assert led2.max_epoch() == 5
+    led2.close()
+
+
+def test_ledger_stale_duplicate_accept_loses(tmp_path):
+    led = RequestLedger(str(tmp_path))
+    _accept(led, "a", 4)
+    _accept(led, "a", 2)  # a zombie's late duplicate: lower epoch loses
+    out = led.outstanding()
+    assert out["a"]["epoch"] == 4
+    assert led.cross_epoch_duplicates == 1
+    led.close()
+
+
+# -- the dual-coordinator scenario -------------------------------------------
+
+
+def test_dual_coordinator_exactly_once_bit_identical(tmp_path):
+    """The acceptance scenario: two coordinators alive on one ledger.
+    The takeover fences the zombie typed; every request resolves
+    exactly once, bit-identical to a healthy serial run."""
+    tables = {f"t{i}": _table(n=48 + 16 * i, seed=700 + i)
+              for i in range(3)}
+    with use_mesh(None):
+        serial = {
+            t: VerificationSuite.run(tbl, [],
+                                     required_analyzers=_analyzers())
+            for t, tbl in tables.items()
+        }
+    ledger_dir = str(tmp_path)
+    fleet_a = _loopback_fleet(ledger_dir=ledger_dir)
+    fleet_b = None
+    try:
+        assert fleet_a.epoch == 1  # fencing auto-armed by ledger_dir
+        futures = {
+            t: fleet_a.submit(tbl, required_analyzers=_analyzers(),
+                              tenant=t)
+            for t, tbl in tables.items()
+        }
+        # takeover while requests may still be in flight: fleet B
+        # resumes on the SAME futures at a higher epoch
+        fleet_b = _loopback_fleet(
+            ledger_dir=ledger_dir,
+            resume_futures={
+                f.accept_id: f for f in futures.values() if not f.done()
+            },
+        )
+        assert fleet_b.epoch == 2
+        # the zombie wakes and tries to keep serving: fenced typed, and
+        # permanently — every later dispatch refuses too
+        for _ in range(2):
+            with pytest.raises(StaleEpochException) as ei:
+                fleet_a.submit(tables["t0"],
+                               required_analyzers=_analyzers(),
+                               tenant="t0")
+            assert ei.value.current_epoch == 2
+        # every future resolves exactly once, bit-identical — whichever
+        # incarnation got there first
+        for t, f in futures.items():
+            _assert_bit_identical(serial[t], f.result(timeout=120),
+                                  label=t)
+            assert f.resolve_count == 1
+        section = fleet_b._section()
+        assert section["epoch"] == 2
+        assert section["fenced"] is False
+        section_a = fleet_a._section()
+        assert section_a["fenced"] is True
+        assert section_a["fencing_rejections"] >= 2
+    finally:
+        if fleet_b is not None:
+            fleet_b.stop(drain=True)
+        fleet_a.stop(drain=False)
+
+
+def test_fencing_env_knob_disables(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEQU_TPU_FENCING", "0")
+    fleet = _loopback_fleet(ledger_dir=str(tmp_path))
+    try:
+        assert fleet.epoch == 0
+        assert fleet._lease is None
+        f = fleet.submit(_table(), required_analyzers=_analyzers(),
+                         tenant="t0")
+        assert f.result(timeout=120) is not None
+    finally:
+        fleet.stop(drain=True)
+    assert not os.path.exists(os.path.join(str(tmp_path), LEASE_FILENAME))
+
+
+def test_fencing_requires_lease_dir():
+    with pytest.raises(ValueError):
+        _loopback_fleet(fencing=True)
+
+
+def test_fencing_counters_surface_in_execution_report():
+    blob = json.dumps(deequ_tpu.execution_report())
+    for name in ("pfleet_fencing_rejections",
+                 "pfleet_zombie_results_ignored",
+                 "crashpoints_survived"):
+        assert name in blob, name

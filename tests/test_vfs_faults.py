@@ -1,0 +1,238 @@
+"""Crashpoint-matrix suite (resilience/vfs_faults.py, round 18) —
+tier-1 `fault`.
+
+Two layers under test:
+
+- the SEAMS themselves: each of the five OS-level write-death modes
+  must leave exactly the physical outcome it models (prefix durable,
+  destination torn, frozen post-crash filesystem that never cleans up);
+- the MATRIX as an oracle: the full seam x byte-boundary sweep over all
+  four durable stores passes (the acceptance gate), AND a deliberately
+  non-atomic store is CAUGHT — a harness that cannot flag a broken
+  store proves nothing.
+"""
+
+import pytest
+
+from deequ_tpu.data.fs import InMemoryFileSystem, filesystem_for
+from deequ_tpu.resilience.vfs_faults import (
+    CrashpointViolation,
+    RequestLedgerAdapter,
+    SimulatedCrash,
+    WriteSeamFileSystem,
+    _FsStoreAdapter,
+    _mount,
+    default_adapters,
+    run_crashpoint_matrix,
+)
+
+pytestmark = pytest.mark.fault
+
+PAYLOAD = b"0123456789abcdef"
+
+
+def _seamed(seam, at_byte):
+    inner = InMemoryFileSystem()
+    return inner, WriteSeamFileSystem(inner, seam, at_byte)
+
+
+# -- the seams themselves ----------------------------------------------------
+
+
+def test_recorder_mode_measures_write_length():
+    inner, fs = _seamed(None, 0)
+    with fs.open("f", "wb") as h:
+        h.write(PAYLOAD)
+    assert inner.files["f"] == PAYLOAD
+    assert fs.last_write_len == len(PAYLOAD)
+    assert not fs.fired
+
+
+def test_enospc_commits_prefix_and_raises():
+    inner, fs = _seamed("enospc", 6)
+    with pytest.raises(OSError) as ei:
+        with fs.open("f", "wb") as h:
+            h.write(PAYLOAD)
+    assert "space" in str(ei.value).lower()
+    assert inner.files["f"] == PAYLOAD[:6]
+    assert fs.fired and not fs.crashed
+
+
+def test_short_write_lies_and_tears_silently():
+    inner, fs = _seamed("short_write", 5)
+    with fs.open("f", "wb") as h:
+        h.write(PAYLOAD)
+        h.fsync()  # the lying stack: fsync reports success too
+    assert inner.files["f"] == PAYLOAD[:5]
+    assert fs.fired  # ...but only the prefix is durable
+
+
+def test_fsync_raises_commits_prefix():
+    inner, fs = _seamed("fsync_raises", 3)
+    with pytest.raises(OSError):
+        with fs.open("f", "wb") as h:
+            h.write(PAYLOAD)
+            h.fsync()
+    assert inner.files["f"] == PAYLOAD[:3]
+    assert not fs.crashed
+
+
+def test_crash_before_fsync_freezes_filesystem():
+    inner, fs = _seamed("crash_before_fsync", 4)
+    inner.files["old"] = b"x"
+    with pytest.raises(SimulatedCrash):
+        with fs.open("f", "wb") as h:
+            h.write(PAYLOAD)
+            h.fsync()
+    assert inner.files["f"] == PAYLOAD[:4]
+    assert fs.crashed
+    # a dead process cleans up nothing: delete/rename silently no-op,
+    # leaving exactly the litter a real crash would
+    fs.delete("f")
+    fs.rename("old", "new")
+    assert inner.files["f"] == PAYLOAD[:4]
+    assert "old" in inner.files and "new" not in inner.files
+
+
+def test_crash_at_rename_leaves_complete_temp():
+    inner, fs = _seamed("crash_at_rename", 0)
+    with fs.open("f.tmp", "wb") as h:
+        h.write(PAYLOAD)
+    with pytest.raises(SimulatedCrash):
+        fs.rename("f.tmp", "f")
+    assert inner.files["f.tmp"] == PAYLOAD  # complete temp survives
+    assert "f" not in inner.files
+    assert fs.crashed
+
+
+def test_simulated_crash_sails_through_except_exception():
+    """The BaseException contract: best-effort ``except Exception``
+    layers (checkpoint saves, cleanup handlers) must not absorb a
+    simulated process death."""
+    with pytest.raises(SimulatedCrash):
+        try:
+            raise SimulatedCrash("crash_before_fsync", "f")
+        except Exception:  # noqa: BLE001 — the point of the test
+            pytest.fail("SimulatedCrash was absorbed by except Exception")
+
+
+def test_unknown_seam_rejected():
+    with pytest.raises(ValueError):
+        WriteSeamFileSystem(InMemoryFileSystem(), "power_loss")
+
+
+def test_crashfs_unmounted_is_typed():
+    _mount(None)
+    with pytest.raises(LookupError):
+        filesystem_for("crashfs://nowhere")
+
+
+# -- the matrix as an oracle -------------------------------------------------
+
+
+class _NaiveStoreAdapter(_FsStoreAdapter):
+    """A deliberately broken store: writes its state file in place with
+    no checksum and no temp+rename. The matrix MUST catch it — a torn
+    committed write leaves garbage the verify pass can read back."""
+
+    name = "naive_store"
+    path = "crashfs://naive/state"
+
+    def _write(self, payload):
+        fs = filesystem_for(self.path)
+        # deequ-lint: ignore[durable-write] -- the point of this fixture IS the non-atomic write the matrix must flag
+        with fs.open(self.path, "wb") as h:
+            h.write(payload)
+
+    def baseline(self):
+        self._write(b"v1|" + b"a" * 13)
+
+    def attempt(self):
+        self._write(b"v2|" + b"b" * 29)
+
+    def verify(self, inner, seam, cut, length, err):
+        got = inner.files.get(self.path)
+        if got not in (b"v1|" + b"a" * 13, b"v2|" + b"b" * 29):
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"state file torn to {got!r} and nothing detected it",
+            )
+
+
+def test_matrix_catches_a_non_atomic_store():
+    adapter = _NaiveStoreAdapter()
+    # short_write tears the destination IN PLACE: baseline overwritten
+    # by a prefix of the new payload — the matrix must raise on it
+    with pytest.raises(CrashpointViolation) as ei:
+        adapter.run_cell("short_write", 7, 32)
+    assert ei.value.store == "naive_store"
+    assert ei.value.seam == "short_write"
+    assert ei.value.cut == 7
+
+
+class _LeakyAdapter(_FsStoreAdapter):
+    """An attempt that dies UNTYPED must fail the cell, not pass as a
+    legitimate write error."""
+
+    name = "leaky_store"
+
+    def baseline(self):
+        pass
+
+    def attempt(self):
+        raise KeyError("untyped internal error")
+
+    def verify(self, inner, seam, cut, length, err):
+        pass
+
+
+def test_matrix_flags_untyped_attempt_leak():
+    with pytest.raises(CrashpointViolation) as ei:
+        _LeakyAdapter().run_cell("enospc", 0, 8)
+    assert "untyped" in ei.value.detail
+    assert "KeyError" in ei.value.detail
+
+
+# -- the acceptance gate -----------------------------------------------------
+
+
+def test_ledger_adapter_sweeps_every_byte():
+    adapter = RequestLedgerAdapter()
+    summary = adapter.run_matrix(stride=1)
+    # every byte boundary of the appended frame, plus the clean cell
+    assert summary["cells"] == summary["write_len"] + 1
+    assert summary["by_seam"] == {"torn_tail": summary["cells"]}
+
+
+def test_full_crashpoint_matrix_every_seam_every_byte():
+    """ISSUE acceptance: the complete stride=1 sweep — every write seam
+    at every byte boundary, over every durable store — passes, and each
+    surviving cell is counted."""
+    from deequ_tpu.obs.registry import CRASHPOINTS_SURVIVED
+
+    before = CRASHPOINTS_SURVIVED.value
+    summary = run_crashpoint_matrix(stride=1)
+    assert set(summary["stores"]) == {
+        "request_ledger", "repository_segment",
+        "control_registry", "stream_checkpoint",
+    }
+    for name, store in summary["stores"].items():
+        assert store["cells"] >= store["write_len"], name
+        # the four FileSystem-backed stores cover all five seams; the
+        # ledger's physical-equivalence column covers torn_tail
+        if name != "request_ledger":
+            assert set(store["by_seam"]) == {
+                "enospc", "short_write", "fsync_raises",
+                "crash_before_fsync", "crash_at_rename",
+            }
+    assert summary["cells"] == summary["survived"]
+    assert summary["cells"] > 1000  # a real sweep, not a subsample
+    assert CRASHPOINTS_SURVIVED.value - before == summary["cells"]
+
+
+def test_default_adapters_cover_every_durable_store():
+    names = {a.name for a in default_adapters()}
+    assert names == {
+        "request_ledger", "repository_segment",
+        "control_registry", "stream_checkpoint",
+    }
