@@ -412,6 +412,176 @@ def measure_kernel_ab(smoke: bool = False):
     }
 
 
+def measure_plan_fusion(n_rows: int = 1 << 16, n_tenants: int = 6):
+    """Whole-run plan-optimizer probe (round 19, ops/segment
+    ``fused_group_counts`` + serve/plan_cache ``SUBPLAN_CACHE`` + the
+    ops/plan_cost admission pricing).
+
+    Hard gates — the probe REFUSES to report (AssertionError) unless:
+
+    - FUSION: a 3-grouping-pass suite under fusion makes ONE histogram
+      dispatch with ONE counts fetch where ``DEEQU_TPU_PLAN_FUSION=0``
+      makes three of each, and every metric is bit-identical between
+      the two runs (exact float-bit compare);
+    - SHARING: an overlapping-tenant mix (the same analyzer core
+      submitted in permuted order per tenant) raises cache
+      effectiveness ABOVE what exact-key hits alone give — every
+      permuted suite misses its exact key yet adopts the shared
+      sub-plan (``subplan_cache_hits`` == permuted submissions,
+      ``programs_built`` == 1);
+    - COST-PRICED ADMISSION: with the cost-drain rate trained,
+      ``retry_after_s`` at the SAME queue depth is strictly larger for
+      a heavier queued-cost mix — retries derive from predicted plan
+      cost, not depth alone."""
+    import os
+    import struct
+
+    from deequ_tpu.analyzers import Completeness, Mean, Minimum, Uniqueness
+    from deequ_tpu.analyzers.runner import AnalysisRunner
+    from deequ_tpu.data.table import Column, ColumnarTable, DType
+    from deequ_tpu.obs.registry import REGISTRY
+    from deequ_tpu.ops.plan_cost import PLAN_COST_MODEL
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+    from deequ_tpu.serve import VerificationService
+    from deequ_tpu.serve.admission import AdmissionController
+    from deequ_tpu.serve.plan_cache import SUBPLAN_CACHE
+
+    rng = np.random.default_rng(19)
+
+    # -- A: cross-pass fusion A/B over K=3 grouping passes ---------------
+    table = ColumnarTable([
+        Column("g1", DType.INTEGRAL,
+               values=rng.integers(0, 1000, n_rows).astype(np.float64)),
+        Column("g2", DType.INTEGRAL,
+               values=rng.integers(0, 50, n_rows).astype(np.float64)),
+        Column("g3", DType.INTEGRAL,
+               values=rng.integers(0, 200, n_rows).astype(np.float64)),
+    ])
+    analyzers = [
+        Uniqueness(("g1",)), Uniqueness(("g2",)), Uniqueness(("g3",)),
+    ]
+
+    def hist_dispatches(snap):
+        return (
+            snap["hist_scatter_dispatches"]
+            + snap["hist_onehot_dispatches"]
+            + snap["hist_pallas_dispatches"]
+        )
+
+    def run(fusion: str):
+        prev = os.environ.get("DEEQU_TPU_PLAN_FUSION")
+        os.environ["DEEQU_TPU_PLAN_FUSION"] = fusion
+        try:
+            SCAN_STATS.reset()
+            t0 = time.time()
+            ctx = AnalysisRunner.do_analysis_run(table, analyzers)
+            wall = time.time() - t0
+        finally:
+            if prev is None:
+                os.environ.pop("DEEQU_TPU_PLAN_FUSION", None)
+            else:
+                os.environ["DEEQU_TPU_PLAN_FUSION"] = prev
+        metrics = {
+            str(a): struct.pack("<d", m.value.get())
+            for a, m in ctx.metric_map.items()
+        }
+        return metrics, SCAN_STATS.snapshot(), wall
+
+    base_metrics, base_snap, base_wall = run("0")
+    fused_metrics, fused_snap, fused_wall = run("1")
+    assert fused_metrics == base_metrics, (
+        "plan-fusion bit-identity violation — refusing to report"
+    )
+    assert hist_dispatches(base_snap) == len(analyzers), base_snap
+    assert hist_dispatches(fused_snap) == 1, (
+        f"fusion dispatch gate violation: {hist_dispatches(fused_snap)} "
+        "dispatches for the fused 3-pass suite — refusing to report"
+    )
+    assert fused_snap["device_fetches"] < base_snap["device_fetches"], (
+        "fusion fetch gate violation — refusing to report"
+    )
+    assert fused_snap["fused_group_passes"] == len(analyzers), fused_snap
+    # the optimizer census reads THROUGH the obs registry section
+    planner = REGISTRY.snapshot()["planner"]
+    assert planner["fused_group_passes"] == len(analyzers), planner
+
+    # -- B: cross-suite sub-plan sharing over an overlapping-tenant mix --
+    SUBPLAN_CACHE.clear()
+    SCAN_STATS.reset()
+    core = [Completeness("x"), Mean("x"), Minimum("y")]
+    small = ColumnarTable([
+        Column("x", DType.FRACTIONAL, values=rng.normal(0, 1, 512)),
+        Column("y", DType.FRACTIONAL, values=rng.normal(5, 2, 512)),
+    ])
+    orders = [
+        [core[i % 3], core[(i + 1) % 3], core[(i + 2) % 3]]
+        for i in range(n_tenants)
+    ]
+    svc = VerificationService(max_batch=1, coalesce_window=0.0)
+    try:
+        results = [
+            svc.submit(
+                small, required_analyzers=tuple(order), tenant=f"t{i}"
+            ).result(timeout=120)
+            for i, order in enumerate(orders)
+        ]
+    finally:
+        svc.stop(drain=False)
+    snap = SCAN_STATS.snapshot()
+    distinct_orders = len({tuple(str(a) for a in o) for o in orders})
+    # every permuted order past the first misses its exact key yet
+    # adopts the shared sub-plan: sharing must beat exact hits alone
+    assert snap["programs_built"] == 1, (
+        f"sub-plan sharing gate violation: {snap['programs_built']} "
+        "programs built for one shared analyzer core — refusing to report"
+    )
+    assert snap["subplan_cache_hits"] == distinct_orders - 1, snap
+    assert snap["subplan_cache_hits"] > 0, "no sub-plan hits"
+    exact_hits = snap["plan_cache_hits"] - snap["subplan_cache_hits"] * len(
+        core
+    )
+    for a in core:
+        vals = {
+            res.metrics[a].value.get() for res in results
+        }
+        assert len(vals) == 1, (str(a), vals)
+
+    # -- C: cost-priced retry_after ordering -----------------------------
+    light = PLAN_COST_MODEL.estimate_suite([Completeness("x")], n_rows).total
+    heavy = PLAN_COST_MODEL.estimate_suite(
+        [Completeness("x"), Mean("x"), Uniqueness(("y",))], n_rows
+    ).total
+    ctl = AdmissionController(max_pending=64)
+    for _ in range(4):
+        ctl.note_served(1, 0.1, cost=light)
+    retry_light = ctl.retry_after(3, queued_cost=3 * light)
+    retry_heavy = ctl.retry_after(3, queued_cost=3 * heavy)
+    assert retry_heavy > retry_light, (
+        "cost-priced admission gate violation: same depth, heavier "
+        "queued cost must schedule a later retry — refusing to report"
+    )
+
+    return {
+        "plan_fusion_dispatch_reduction_x": round(
+            hist_dispatches(base_snap) / hist_dispatches(fused_snap), 2
+        ),
+        "plan_fusion_fetches": (
+            f"{fused_snap['device_fetches']} fused vs "
+            f"{base_snap['device_fetches']} unfused"
+        ),
+        "plan_fusion_wall_speedup_x": round(
+            base_wall / fused_wall, 2
+        ) if fused_wall > 0 else float("inf"),
+        "plan_fusion_bit_identical": True,
+        "subplan_cache_hits": snap["subplan_cache_hits"],
+        "subplan_programs_built": snap["programs_built"],
+        "subplan_exact_hits_alone": max(int(exact_hits), 0),
+        "cost_retry_light_s": round(retry_light, 4),
+        "cost_retry_heavy_s": round(retry_heavy, 4),
+        "cost_priced_admission": True,
+    }
+
+
 def measure_ingest_overlap(n_batches: int, batch_rows: int):
     """Columnar-ingest probe (round 8, the config-4/5 ingest-bound
     shape): ONE streaming analysis over ``n_batches`` dictionary-

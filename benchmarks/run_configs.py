@@ -238,6 +238,33 @@ def config11(n_windows: int):
     )
 
 
+def config12(n_rows: int):
+    """PLAN-OPTIMIZER config (round 19, ops/segment
+    ``fused_group_counts`` + serve/plan_cache ``SUBPLAN_CACHE`` +
+    ops/plan_cost): a 3-grouping-pass suite A/B fused vs
+    ``DEEQU_TPU_PLAN_FUSION=0``, an overlapping-tenant mix of permuted
+    suites through the service, and the cost-priced admission check.
+    ONE workload definition, shared with bench.py's
+    ``measure_plan_fusion`` probe, which hard-asserts — before it
+    reports anything — ONE dispatch + fewer fetches + bit-identity for
+    the fused 3-pass suite, sub-plan sharing raising cache
+    effectiveness above exact-key hits alone (every permuted suite
+    misses its exact key yet builds zero programs), and retry_after_s
+    ordering by predicted queued cost at equal queue depth."""
+    import bench
+
+    probe = bench.measure_plan_fusion(n_rows)
+    return _emit(
+        config=12, metric="plan_fusion_dispatch_reduction_x",
+        rows=n_rows,
+        value=probe["plan_fusion_dispatch_reduction_x"], unit="x dispatches",
+        **{
+            k: v for k, v in probe.items()
+            if k != "plan_fusion_dispatch_reduction_x"
+        },
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -774,6 +801,11 @@ def main():
         # check set (profile coalescing / repeat zero-trace / shadow-
         # never-sheds-critical / replay reproducibility asserted inside)
         11: lambda: config11(args.rows or 6),
+        # round-19 plan-optimizer config: the 3-pass grouping fusion
+        # A/B + permuted-suite sub-plan sharing + cost-priced admission
+        # (one-dispatch / bit-identity / sharing-beats-exact-hits /
+        # cost-ordered-retries gates asserted inside)
+        12: lambda: config12(args.rows or (1 << 16)),
     }
     if args.all:
         for k in sorted(runners):
@@ -786,7 +818,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5,6,7,8,9,10,11} or --all")
+        ap.error("--config {1,2,3,4,5,6,7,8,9,10,11,12} or --all")
 
 
 if __name__ == "__main__":

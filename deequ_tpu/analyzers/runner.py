@@ -122,6 +122,26 @@ def _is_grouping_shared(analyzer: Analyzer) -> bool:
     )
 
 
+def _count_stats_capable(a) -> bool:
+    """True when the analyzer is a pure function of the count
+    distribution (the ``group_count_stats`` fast path — group values
+    never decode to host). Gated on an explicit override (not hasattr,
+    which every subclass inherits): a subclass that only implements
+    compute_from_frequencies falls back to the frequency table instead
+    of having its NotImplementedError swallowed into a failure
+    metric. Shared by the per-set pass and the round-19 fusion
+    pre-pass so both pick the same finalize shape per set."""
+    from deequ_tpu.analyzers.grouping import (
+        ScanShareableFrequencyBasedAnalyzer as _SSF,
+    )
+
+    return (
+        isinstance(a, _SSF)
+        and type(a).compute_from_count_stats
+        is not _SSF.compute_from_count_stats
+    )
+
+
 def _release_spill(folder) -> None:
     """Free a fold's temp spill directory when its ``result()`` will never
     run (failed fold / aborted pass) — one copy of the private-attribute
@@ -369,12 +389,21 @@ class AnalysisRunner:
 
         # (5) grouping analyzers share one frequency table per distinct
         # sorted grouping-column set (reference L175-190; partition built
-        # above, shared with the resilient branch)
+        # above, shared with the resilient branch). The plan optimizer
+        # (round 19) first tries to FUSE the dense sets into one device
+        # dispatch; sets it computed skip their per-set pass, sets it
+        # skipped (sparse/streaming/budgeted/faulted) run exactly as
+        # before.
         group_ctx = AnalyzerContext.empty()
+        fused_states = AnalysisRunner._fuse_grouping_sets(
+            data, by_grouping, aggregate_with, save_states_with,
+            group_memory_budget,
+        )
         for group_key, group_analyzers in by_grouping.items():
             group_ctx += AnalysisRunner._run_grouping_analyzers(
                 data, list(group_key), group_analyzers, aggregate_with,
                 save_states_with, group_memory_budget=group_memory_budget,
+                precomputed=fused_states.get(group_key),
             )
 
         result = (
@@ -1126,6 +1155,54 @@ class AnalysisRunner:
         return ctx
 
     @staticmethod
+    def _fuse_grouping_sets(
+        data,
+        by_grouping,
+        aggregate_with,
+        save_states_with,
+        group_memory_budget,
+    ) -> Dict[Tuple[str, ...], object]:
+        """Cross-pass fusion pre-pass (the round-19 plan optimizer): hand
+        every in-memory grouping set to ``ops.segment.fused_group_counts``
+        in one call so the dense ones ride a SINGLE device dispatch.
+        Returns ``{group_key: state}`` for the sets it computed; anything
+        absent runs the ordinary per-set pass (which also owns the
+        per-set failure-metric wrapping — fusion never converts a set
+        failure into a whole-run failure)."""
+        from deequ_tpu.ops.scan_plan import plan_fusion_enabled
+
+        if not plan_fusion_enabled():
+            return {}
+        if len(by_grouping) < 2 or getattr(data, "is_streaming", False):
+            return {}
+        from deequ_tpu.spill import resolve_group_budget
+
+        if resolve_group_budget(data, group_memory_budget) is not None:
+            # budgeted runs batch/spill per set — fusion's one-vector
+            # dispatch would defeat the memory bound
+            return {}
+        from deequ_tpu.ops.segment import GroupRequest, fused_group_counts
+
+        keys = list(by_grouping)
+        requests = []
+        for g in keys:
+            stats_mode = (
+                aggregate_with is None
+                and save_states_with is None
+                and all(_count_stats_capable(a) for a in by_grouping[g])
+            )
+            requests.append(
+                GroupRequest(tuple(g), "stats" if stats_mode else "freq")
+            )
+        try:
+            computed = fused_group_counts(data, requests)
+        except Exception:  # noqa: BLE001
+            # a fault escaping the fused path's own ladder falls back to
+            # the per-set passes, which surface it per analyzer
+            return {}
+        return {keys[i]: state for i, state in computed.items()}
+
+    @staticmethod
     def _run_grouping_analyzers(
         data: ColumnarTable,
         grouping_columns: List[str],
@@ -1133,6 +1210,7 @@ class AnalysisRunner:
         aggregate_with=None,
         save_states_with=None,
         group_memory_budget=None,
+        precomputed=None,
     ) -> AnalyzerContext:
         from deequ_tpu.ops.segment import group_count_stats, group_counts_state
         from deequ_tpu.spill import resolve_group_budget
@@ -1197,24 +1275,17 @@ class AnalysisRunner:
         # implements compute_from_frequencies falls back to the frequency
         # table instead of having its NotImplementedError swallowed into a
         # failure metric.
-        from deequ_tpu.analyzers.grouping import (
-            ScanShareableFrequencyBasedAnalyzer as _SSF,
-        )
-
-        def _has_count_stats(a) -> bool:
-            return (
-                isinstance(a, _SSF)
-                and type(a).compute_from_count_stats
-                is not _SSF.compute_from_count_stats
-            )
-
         if (
             aggregate_with is None
             and save_states_with is None
-            and all(_has_count_stats(a) for a in analyzers)
+            and all(_count_stats_capable(a) for a in analyzers)
         ):
             try:
-                stats = group_count_stats(data, grouping_columns)
+                stats = (
+                    precomputed
+                    if precomputed is not None
+                    else group_count_stats(data, grouping_columns)
+                )
             except Exception as e:  # noqa: BLE001
                 wrapped = wrap_if_necessary(e)
                 return AnalyzerContext(
@@ -1241,7 +1312,11 @@ class AnalysisRunner:
                 )
 
         try:
-            state: Optional[State] = group_counts_state(data, grouping_columns)
+            state: Optional[State] = (
+                precomputed
+                if precomputed is not None
+                else group_counts_state(data, grouping_columns)
+            )
         except Exception as e:  # noqa: BLE001
             wrapped = wrap_if_necessary(e)
             return AnalyzerContext(

@@ -202,6 +202,25 @@ HOST_GROUP_LIMIT = register(EnvVar(
         "latency regime; unset = the module default 2^14; sweepable by "
         "the kernel A/B probe, PR 14)",
 ))
+HIST_CPU_CAP = register(EnvVar(
+    "DEEQU_TPU_HIST_CPU_CAP", "int", default=None, minimum=1,
+    doc="widest keyspace the one-hot matmul kernel accepts on a CPU "
+        "backend (ops/device_policy.resolve_hist_variant crossover; "
+        "unset = the module default 32 — the round-14 sweep point; read "
+        "by the plan-cost model, PR 19 autotuner groundwork)",
+))
+HIST_ACCEL_CAP = register(EnvVar(
+    "DEEQU_TPU_HIST_ACCEL_CAP", "int", default=None, minimum=1,
+    doc="widest keyspace the one-hot matmul kernel accepts on an "
+        "accelerator backend (unset = the module default 2^17 — the "
+        "factored bf16 planes bound; read by the plan-cost model, "
+        "PR 19 autotuner groundwork)",
+))
+PLAN_FUSION = register(EnvVar(
+    "DEEQU_TPU_PLAN_FUSION", "flag01", default=True,
+    doc="0 disables cross-pass grouping fusion (the whole-run plan "
+        "optimizer's single-dispatch grouping path, PR 19 A/B hatch)",
+))
 DEVICE_DEADLINE = register(EnvVar(
     "DEEQU_TPU_DEVICE_DEADLINE", "float", default=None,
     zero_disables=True,

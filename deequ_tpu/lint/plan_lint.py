@@ -59,6 +59,17 @@ Rules (ids are stable; severities per ``findings.LintFinding``):
   callback (an in-program decode round trip the fused-gather contract
   forbids; re-asserted here per encoded program on top of
   ``plan-host-callback`` so the encoded rule is self-contained).
+- ``plan-fusion-refetch`` (error) — a FUSED multi-pass plan
+  (``ScanPlan.fusion`` non-empty, the round-19 cross-pass grouping
+  fusion) whose traced program produces more than one output (each
+  sub-pass would materialize — fetch — separately, silently reverting
+  fusion's one-fetch-for-K-passes contract while
+  ``fused_group_passes`` still reports the fused census) or smuggles a
+  host-boundary primitive (a per-sub-pass host round trip). The
+  companion :func:`check_subplan_key` guards the cross-suite SHARED
+  sub-plan cache under the same rule id: a sub-plan memo key that
+  omits its layout or kernel-variant components would let tenants with
+  different packer layouts or kernel tiers share one traced program.
 
 PACKED multi-tenant plans (``ScanPlan.tenants > 0`` — the serve layer's
 coalesced dispatch, deequ_tpu/serve) run the same rules PLUS a
@@ -486,6 +497,31 @@ def lint_plan(
                         "planner binding drift, rejected before dispatch",
                     )
                 )
+        fusion = getattr(plan_ir, "fusion", ()) or ()
+        if fusion:
+            outs = len(closed.jaxpr.outvars)
+            if outs != 1:
+                findings.append(
+                    LintFinding(
+                        "plan-fusion-refetch",
+                        "error",
+                        f"fused {len(fusion)}-pass plan traces to a "
+                        f"program with {outs} outputs: each sub-pass "
+                        "would materialize (fetch) separately — fusion's "
+                        "one-fetch contract requires ONE concatenated "
+                        "counts output for all sub-passes",
+                    )
+                )
+            if callbacks:
+                findings.append(
+                    LintFinding(
+                        "plan-fusion-refetch",
+                        "error",
+                        f"fused multi-pass program contains host-boundary "
+                        f"primitive(s) {callbacks}: a per-sub-pass host "
+                        "round trip defeats the single fused dispatch",
+                    )
+                )
         nondet = _float_unsorted_scatters(closed.jaxpr)
         if nondet:
             findings.append(
@@ -500,6 +536,38 @@ def lint_plan(
             )
     findings.sort(key=lambda f: (f.severity != "error", f.rule))
     return findings
+
+
+#: the components a cross-suite sub-plan cache key must carry: dropping
+#: any of them would let suites with different packer layouts / kernel
+#: tiers / ingest routing share one traced program
+_SUBPLAN_KEY_FIELDS = ("ops_sig", "layout_sig", "variant", "hist_variant",
+                       "ingest_variant")
+
+
+def check_subplan_key(key) -> List[LintFinding]:
+    """The shared-sub-plan half of ``plan-fusion-refetch``: validate
+    that a cross-suite sub-plan cache key (serve/plan_cache.SubPlanKey)
+    carries every identity component. A key whose layout or variant
+    field is empty/None would hash suites with DIFFERENT packer layouts
+    or kernel variants onto the same traced program — the packed twin
+    of serving a sort-path program to a selection-path scan. Called by
+    the serve executor before a shared sub-plan is admitted (when lint
+    is armed) and by the drift sims."""
+    missing = [
+        f for f in _SUBPLAN_KEY_FIELDS if not getattr(key, f, None)
+    ]
+    if not missing:
+        return []
+    return [
+        LintFinding(
+            "plan-fusion-refetch",
+            "error",
+            f"shared sub-plan cache key omits identity component(s) "
+            f"{missing}: suites with different layouts/kernel variants "
+            "would share one traced program",
+        )
+    ]
 
 
 # -- memoization --------------------------------------------------------

@@ -448,6 +448,29 @@ def _kernels_section() -> dict:
     }
 
 
+def _planner_section() -> dict:
+    """Read-through over the whole-run plan optimizer (round 19,
+    ops/segment.fused_group_counts + serve/plan_cache.SUBPLAN_CACHE):
+    fused grouping-pass count, sub-plan cache hit count, and the fusion
+    knob as resolved — the observable triple the plan-fusion A/B probe
+    (bench.measure_plan_fusion) reads to prove fusion actually grouped
+    and sharing actually hit."""
+    from deequ_tpu.envcfg import EnvConfigError, env_value
+    from deequ_tpu.ops.scan_engine import SCAN_STATS
+
+    try:
+        fusion = env_value("DEEQU_TPU_PLAN_FUSION")
+    except EnvConfigError as e:
+        # same degrade-to-error-string contract as _kernels_section: a
+        # scrape reports the bad knob, never dies on it
+        fusion = f"error: {e}"
+    return {
+        "fused_group_passes": SCAN_STATS.fused_group_passes,
+        "subplan_cache_hits": SCAN_STATS.subplan_cache_hits,
+        "plan_fusion": fusion,
+    }
+
+
 def _control_section() -> dict:
     """Read-through over the closed-loop control plane (round 16,
     deequ_tpu/control): checks per lifecycle state, promotion/demotion
@@ -471,6 +494,7 @@ REGISTRY.register_collector("hbm", _hbm_section)
 REGISTRY.register_collector("env", _env_section)
 REGISTRY.register_collector("repository", _repository_section)
 REGISTRY.register_collector("kernels", _kernels_section)
+REGISTRY.register_collector("planner", _planner_section)
 REGISTRY.register_collector("control", _control_section)
 
 

@@ -93,6 +93,26 @@ HIST_ONEHOT_MXU_MAX_SEGMENTS = 1 << 17
 HIST_MIN_ROWS = 1 << 14
 
 
+def hist_cpu_cap() -> int:
+    """The CPU one-hot crossover cap: ``DEEQU_TPU_HIST_CPU_CAP`` when
+    set, else the module constant (which tests may monkeypatch — the
+    ``host_group_limit()`` idiom from ops/segment.py). Also a plan-cost
+    model input (ops/plan_cost.py)."""
+    from deequ_tpu.envcfg import env_value
+
+    configured = env_value("DEEQU_TPU_HIST_CPU_CAP")
+    return HIST_ONEHOT_CPU_MAX_SEGMENTS if configured is None else configured
+
+
+def hist_accel_cap() -> int:
+    """The accelerator one-hot crossover cap: ``DEEQU_TPU_HIST_ACCEL_CAP``
+    when set, else the module constant."""
+    from deequ_tpu.envcfg import env_value
+
+    configured = env_value("DEEQU_TPU_HIST_ACCEL_CAP")
+    return HIST_ONEHOT_MXU_MAX_SEGMENTS if configured is None else configured
+
+
 def resolve_hist_variant(
     widths,
     rows: Optional[int] = None,
@@ -134,11 +154,7 @@ def resolve_hist_variant(
         import jax
 
         platform = jax.default_backend()
-    cap = (
-        HIST_ONEHOT_CPU_MAX_SEGMENTS
-        if platform == "cpu"
-        else HIST_ONEHOT_MXU_MAX_SEGMENTS
-    )
+    cap = hist_cpu_cap() if platform == "cpu" else hist_accel_cap()
     if max(widths) <= cap:
         return "onehot"
     return "scatter"

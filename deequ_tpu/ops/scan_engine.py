@@ -374,6 +374,14 @@ class ScanStats:
         self.coalesced_batches = 0
         self.coalesced_tenants = 0
         self.coalesce_padded_slots = 0
+        # whole-run plan optimizer (round 19): grouping passes that rode
+        # a FUSED multi-pass dispatch (each fused group of K passes
+        # counts K here while paying ONE record_hist_dispatch + ONE
+        # fetch), and serving suites whose packed program came from the
+        # cross-suite SUB-PLAN cache (a canonical-op-order hit below the
+        # exact PlanKey). Read through the obs "planner" section.
+        self.fused_group_passes = 0
+        self.subplan_cache_hits = 0
 
     @property
     def ingest_overlap_frac(self) -> float:
@@ -444,6 +452,21 @@ class ScanStats:
         field_name = f"hist_{variant}_dispatches"
         with self._fetch_lock:
             setattr(self, field_name, getattr(self, field_name) + int(n))
+
+    def record_fused_group_pass(self, n: int = 1) -> None:
+        """Account ``n`` grouping passes that executed inside one fused
+        multi-pass dispatch (the plan optimizer's cross-pass fusion).
+        Lock-serialized like the hist census — the serve/fleet workers
+        share the singleton."""
+        with self._fetch_lock:
+            self.fused_group_passes += int(n)
+
+    def record_subplan_hit(self, n: int = 1) -> None:
+        """Account ``n`` tenant suites served from the cross-suite
+        sub-plan cache (a shared traced program below the exact
+        PlanKey). Lock-serialized like the fetch ledger."""
+        with self._fetch_lock:
+            self.subplan_cache_hits += int(n)
 
     def record_staged(self, nbytes: int, overlapped: bool) -> None:
         """Account one HOST->DEVICE chunk staging (the double-buffered
@@ -1815,6 +1838,9 @@ def _maybe_plan_lint(
                     plan_ir.ingest_variant,
                     plan_ir.encoded_columns,
                     plan_ir.fold_tags,
+                    # fusion signature: fused and unfused variants of the
+                    # same op set lint separately (plan-fusion-refetch)
+                    plan_ir.fusion,
                     bool(fallback),
                 )
         findings, traced = lint_plan_cached(
@@ -2113,50 +2139,20 @@ def run_scan(
     window = _resolve_scan_window(window)
     scan_id = next(_SCAN_IDS)
     rec = current_recorder()
-    if getattr(table, "is_streaming", False):
-        if defer:
-            raise ValueError(
-                "defer=True is for in-memory batch tables; streaming scans "
-                "already pipeline internally"
-            )
-        # the straggler deadline arms the stream's mesh dispatches too: a
-        # half-consumed stream cannot reshard (no rewind), but a stalled
-        # collective must still become a TYPED DeviceHangException rather
-        # than a frozen run — use the tighter of the two deadlines
-        stream_deadline = device_deadline
-        if shard_deadline is not None and mesh is not None and (
-            math.prod(mesh.devices.shape) > 1
-        ):
-            stream_deadline = (
-                shard_deadline
-                if device_deadline is None
-                else min(device_deadline, shard_deadline)
-            )
-        # a run budget with a wall deadline bounds the WHOLE stream scan
-        # with one attempt-level watchdog (one worker thread per governed
-        # scan, not per device call — the <1% healthy-path contract): a
-        # hung dispatch becomes a typed DeviceHangException inside
-        # run_deadline. A whole stream scan is ONE attempt span (streams
-        # never retry in here — see _run_scan_stream's budget audit).
-        with (
-            rec.span("scan_attempt", scan_id=scan_id, attempt=0,
-                     stream=True)
-            if rec is not None
-            else nullcontext()
-        ):
-            return _governed_attempt(
-                budget,
-                lambda: _run_scan_stream(
-                    table, ops, chunk_rows, mesh,
-                    scan_id=scan_id, device_deadline=stream_deadline,
-                    window=window, select_kernel=select_kernel,
-                    plan_lint=plan_lint, encoded=encoded_ingest,
-                ),
-                f"stream scan {scan_id} (run budget)",
-            )
+    from deequ_tpu.ops import scan_executors
 
-    chunk_override = chunk_rows
-    attempt = 0
+    kind = scan_executors.classify(table, mesh)
+    if kind == "streaming":
+        return scan_executors.run_streaming_scan(
+            table, ops,
+            chunk_rows=chunk_rows, mesh=mesh, defer=defer,
+            device_deadline=device_deadline,
+            shard_deadline=shard_deadline, window=window,
+            select_kernel=select_kernel, plan_lint=plan_lint,
+            encoded_ingest=encoded_ingest, budget=budget,
+            scan_id=scan_id, rec=rec,
+        )
+
     # fallback needs a CPU backend to land on; a process pinned to the
     # accelerator platform only degrades to raising the typed error
     can_fallback = (
@@ -2197,238 +2193,18 @@ def run_scan(
             else "unhealthy_backend",
             consecutive_faults=DEVICE_HEALTH.consecutive_faults,
         )
-    depth = 0
-    while True:
-        # one span per ladder attempt: the seam spans (transfer/
-        # trace/execute/fetch via device_call) nest under it, and a
-        # rung firing in the except blocks below records its instant
-        # event INSIDE the attempt span it degraded
-        with (
-            rec.span(
-                "scan_attempt", scan_id=scan_id, attempt=attempt,
-                fallback=fallback,
-            )
-            if rec is not None
-            else nullcontext()
-        ):
-            n_dev = _mesh_size(mesh)
-            floor = max(n_dev, min(MIN_BISECT_CHUNK_ROWS, max(table.num_rows, 1)))
-            # straggler watchdog: on a MULTI-chip dispatch the per-shard
-            # deadline bounds how long one stalled chip may hold a collective
-            straggler_armed = shard_deadline is not None and n_dev > 1
-            attempt_deadline = device_deadline
-            if straggler_armed:
-                attempt_deadline = (
-                    shard_deadline
-                    if device_deadline is None
-                    else min(device_deadline, shard_deadline)
-                )
-            scan_ctx = {
-                "scan_id": scan_id, "attempt": attempt, "fallback": fallback,
-                "device_ids": mesh_device_ids(mesh),
-            }
-            report: Dict[str, Any] = {}
-
-            def _reshard_after(e: DeviceException) -> bool:
-                """Shrink the mesh around the chip(s) ``e`` implicates; True
-                when a healthy accelerator subset remains and the scan should
-                re-dispatch on it."""
-                nonlocal mesh, chunk_override, depth
-                mesh_ids = set(mesh_device_ids(mesh))
-                lost = [
-                    d for d in getattr(e, "device_ids", ()) if d in mesh_ids
-                ]
-                if not lost or len(mesh_ids) <= 1:
-                    return False
-                SCAN_STATS.mesh_faults += 1
-                MESH_HEALTH.record_fault(e)
-                new_mesh = mesh_excluding(
-                    mesh, set(lost) | set(MESH_HEALTH.quarantined())
-                )
-                if new_mesh is None:
-                    return False
-                # residency is pinned (sharded) onto the OLD mesh — including
-                # the dead chip(s); it cannot serve the shrunken mesh
-                freed = _evict_device_cache(table)
-                SCAN_STATS.mesh_reshards += 1
-                SCAN_STATS.record_degradation(
-                    "mesh_reshard", scan_id=scan_id,
-                    lost_devices=sorted(lost),
-                    mesh_from=len(mesh_ids), mesh_to=_mesh_size(new_mesh),
-                    evicted_bytes=freed, error=str(e),
-                )
-                mesh = new_mesh
-                # the pressure that drove any bisection left with the chip:
-                # restart at the caller's chunk size, or a per-chip OOM that
-                # bottomed out at the ~64-row floor would pin the WHOLE rest
-                # of the scan at floor-sized dispatches on a healthy mesh (a
-                # recurring OOM on the survivors simply re-bisects)
-                chunk_override = chunk_rows
-                depth = 0
-                return True
-
-            try:
-                if fallback:
-                    SCAN_STATS.fallback_scans += 1
-                    SCAN_STATS.fallback_backend = "cpu"
-                    # the resident chunks (and on single-device setups even a
-                    # mesh=None cache) are committed to the ACCELERATOR —
-                    # jax.default_device cannot move committed arrays, so the
-                    # fallback must drop residency or it would dispatch right
-                    # back onto the device it is fleeing
-                    _evict_device_cache(table)
-
-                    def _fallback_once():
-                        # jax.default_device is THREAD-LOCAL: the context
-                        # must open inside the (possibly watchdog-worker)
-                        # thread that runs the attempt. The per-call
-                        # watchdog stays disarmed here — it exists to detect
-                        # a hung ACCELERATOR, and the CPU re-jit
-                        # legitimately pays a fresh compile — but the run
-                        # budget's attempt-level watchdog still bounds the
-                        # whole rung, so termination within run_deadline
-                        # covers the fallback too
-                        with jax.default_device(_cpu_fallback_device()):
-                            return _run_scan_once(
-                                table, ops, chunk_override, None, defer,
-                                None, scan_ctx, report, window,
-                                select_kernel=select_kernel,
-                                plan_lint=plan_lint,
-                                encoded=encoded_ingest,
-                            )
-
-                    return _governed_attempt(
-                        budget, _fallback_once,
-                        f"scan {scan_id} CPU fallback (run budget)",
-                    )
-                result = _governed_attempt(
-                    budget,
-                    lambda: _run_scan_once(
-                        table, ops, chunk_override, mesh, defer,
-                        attempt_deadline, scan_ctx, report, window,
-                        select_kernel=select_kernel, plan_lint=plan_lint,
-                        encoded=encoded_ingest,
-                    ),
-                    f"scan {scan_id} attempt {attempt} (run budget)",
-                )
-                DEVICE_HEALTH.record_success()
-                if n_dev > 1:
-                    MESH_HEALTH.record_success(mesh_device_ids(mesh))
-                return result
-            except DeviceOOMException as e:
-                SCAN_STATS.device_faults += 1
-                if not fallback:  # CPU-side faults are not accelerator health
-                    DEVICE_HEALTH.record_fault(e)
-                used = report.get("chunk") or chunk_override or DEFAULT_CHUNK_ROWS
-                freed = _evict_device_cache(table)
-                # encoded -> decoded demotion FIRST, like the PR-6
-                # selection -> sort re-plan: the encoded attempt's decode
-                # gathers/dictionary LUTs are the allocations the fault
-                # implicates that the decoded program simply doesn't have —
-                # retry on the known-good decoded path at the same chunk
-                # size; a recurring OOM there bisects as before
-                if not fallback and encoded_ingest and report.get("encoded"):
-                    # every ladder retry charges the run budget FIRST: an
-                    # exhausted budget raises typed here instead of spending
-                    # another rung (the charge exception carries the ledger)
-                    if budget is not None:
-                        budget.charge("encoded_demote", scan_id=scan_id)
-                    encoded_ingest = False
-                    SCAN_STATS.encoded_demotions += 1
-                    SCAN_STATS.record_degradation(
-                        "encoded_demote", scan_id=scan_id, chunk=int(used),
-                        evicted_bytes=freed, error=str(e),
-                    )
-                    attempt += 1
-                    continue
-                halved = max(floor, used // 2)
-                halved = max(n_dev, (halved // n_dev) * n_dev)
-                if halved < used and not fallback:
-                    if budget is not None:
-                        budget.charge("oom_bisect", scan_id=scan_id)
-                    depth += 1
-                    SCAN_STATS.oom_bisections += 1
-                    SCAN_STATS.bisection_depth = max(
-                        SCAN_STATS.bisection_depth, depth
-                    )
-                    SCAN_STATS.record_degradation(
-                        "oom_bisect", scan_id=scan_id, chunk_from=int(used),
-                        chunk_to=int(halved), depth=depth, evicted_bytes=freed,
-                        error=str(e),
-                    )
-                    chunk_override = halved
-                    attempt += 1
-                    continue
-                # at the bisection floor: a per-CHIP OOM (the message named
-                # its device) can still shed the sick member and retry on the
-                # healthy remainder before any CPU fallback
-                if not fallback and _reshard_after(e):
-                    if budget is not None:
-                        budget.charge("mesh_reshard", scan_id=scan_id)
-                    attempt += 1
-                    continue
-                # bisection and resharding cannot help any further
-                if can_fallback and not fallback:
-                    if budget is not None:
-                        budget.charge("cpu_fallback", scan_id=scan_id)
-                    fallback = True
-                    attempt += 1
-                    SCAN_STATS.record_degradation(
-                        "cpu_fallback", scan_id=scan_id,
-                        reason="oom_at_bisection_floor", chunk=int(used),
-                        error=str(e),
-                    )
-                    continue
-                raise
-            except DeviceException as e:
-                SCAN_STATS.device_faults += 1
-                if isinstance(e, DeviceHangException):
-                    SCAN_STATS.watchdog_timeouts += 1
-                    # a hang on a multi-chip dispatch is a straggling
-                    # collective only when the PER-SHARD deadline was the one
-                    # that bound (attempt_deadline = min of the two): a hang
-                    # tripping a tighter device_deadline is a general watchdog
-                    # timeout and must not be mislabeled as a straggler
-                    if straggler_armed and (
-                        device_deadline is None
-                        or shard_deadline <= device_deadline
-                    ):
-                        SCAN_STATS.mesh_stragglers += 1
-                        SCAN_STATS.record_degradation(
-                            "mesh_straggler", scan_id=scan_id,
-                            deadline=e.deadline, mesh_size=n_dev, error=str(e),
-                        )
-                    else:
-                        SCAN_STATS.record_degradation(
-                            "watchdog_timeout", scan_id=scan_id,
-                            deadline=e.deadline, error=str(e),
-                        )
-                # the degraded-mesh ladder comes BEFORE the whole-backend
-                # ladder: a fault attributable to specific mesh members costs
-                # those members, never the backend — the run continues on the
-                # largest healthy subset, and the CPU fallback is reached only
-                # when no accelerator subset remains
-                if not fallback and _reshard_after(e):
-                    if budget is not None:
-                        budget.charge("mesh_reshard", scan_id=scan_id)
-                    attempt += 1
-                    continue
-                if not fallback:  # CPU-side faults are not accelerator health
-                    DEVICE_HEALTH.record_fault(e)
-                # compile / lost / hang with no healthy subset left: retrying
-                # the same program on the same backend cannot help — fall
-                # back or raise typed
-                if can_fallback and not fallback:
-                    if budget is not None:
-                        budget.charge("cpu_fallback", scan_id=scan_id)
-                    fallback = True
-                    attempt += 1
-                    SCAN_STATS.record_degradation(
-                        "cpu_fallback", scan_id=scan_id,
-                        reason=type(e).__name__, error=str(e),
-                    )
-                    continue
-                raise
+    # the executor split (round 19): resident and sharded scans share one
+    # ladder body in ops/scan_executors.py (the mesh rungs self-gate on
+    # mesh size); re-classify after quarantine may have shrunk the mesh
+    return scan_executors.EXECUTORS[scan_executors.classify(table, mesh)](
+        table, ops,
+        chunk_rows=chunk_rows, mesh=mesh, defer=defer,
+        on_device_error=on_device_error,
+        device_deadline=device_deadline, shard_deadline=shard_deadline,
+        window=window, select_kernel=select_kernel, plan_lint=plan_lint,
+        encoded_ingest=encoded_ingest, budget=budget, scan_id=scan_id,
+        rec=rec, fallback=fallback,
+    )
 
 
 def _run_scan_once(

@@ -165,6 +165,14 @@ class ScanPlan:
     #: encoded column arriving pre-decoded on the group layout, is a
     #: per-slice violation even though the program is shared
     members: Tuple = ()
+    #: cross-pass FUSION signature (round 19): the per-sub-pass keyspace
+    #: widths of a fused multi-grouping dispatch, in sub-pass order; ()
+    #: = an ordinary unfused plan. A fused plan's traced program must
+    #: produce exactly ONE output (the concatenated counts vector — one
+    #: fetch for all sub-passes) and smuggle no host callbacks: the
+    #: ``plan-fusion-refetch`` lint rule. Also a lint-memo-key component
+    #: so fused and unfused variants of the same op set lint separately.
+    fusion: Tuple[int, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -212,6 +220,56 @@ def plan_packed_scan(
         base,
         tenants=len(members),
         members=tuple(members),
+    )
+
+
+def plan_fusion_enabled(param: Optional[bool] = None) -> bool:
+    """Resolve the cross-pass fusion switch: explicit argument wins,
+    then DEEQU_TPU_PLAN_FUSION ('0' disables — the plan-optimizer A/B
+    hatch, round 19), then on. Validated like the sibling switches."""
+    from deequ_tpu.envcfg import env_value
+
+    if param is not None:
+        if not isinstance(param, (bool, int)) or param not in (0, 1):
+            raise ValueError(
+                f"plan_fusion must be True/False, got {param!r}"
+            )
+        return bool(param)
+    return env_value("DEEQU_TPU_PLAN_FUSION")
+
+
+def plan_fused_grouping(
+    keyspaces: Sequence[int],
+    rows: Optional[int] = None,
+    hist_variant: Optional[str] = None,
+) -> ScanPlan:
+    """Resolve the FUSED multi-grouping plan (round 19): K dense
+    grouping passes sharing one dispatch. The plan carries no ScanOps —
+    its program is the offset-bincount the segment layer builds — but it
+    declares the contracts the ``plan-fusion-refetch`` lint rule checks:
+    the ``fusion`` signature (per-sub-pass keyspaces), the one-fetch
+    contract (ONE concatenated counts output for all K sub-passes), and
+    the histogram kernel tier the single dispatch rides. Re-derived per
+    attempt, like every plan: a fault that demotes the fused dispatch
+    re-plans the sub-passes unfused (``fusion=()``) automatically."""
+    from deequ_tpu.ops.device_policy import resolve_hist_variant
+
+    widths = tuple(int(k) for k in keyspaces)
+    if len(widths) < 2:
+        raise ValueError(
+            f"a fused grouping plan needs >= 2 sub-passes, got {widths!r}"
+        )
+    if hist_variant is None:
+        # the fused dispatch is ONE bincount over the summed keyspace —
+        # the variant policy prices that total width, not the sub-passes
+        hist_variant = resolve_hist_variant((sum(widths) + 1,), rows=rows)
+    return ScanPlan(
+        ops=(),
+        resident=False,
+        variant="none",
+        hist_variant=hist_variant,
+        fetch_contract="one-fetch",
+        fusion=widths,
     )
 
 

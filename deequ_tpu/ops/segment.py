@@ -551,6 +551,137 @@ def _typed_values(col_dtype: DType, values: List) -> np.ndarray:
     return np.asarray(values, dtype=np.float64)
 
 
+@dataclass
+class _GroupPrep:
+    """One grouping set's PREPARED key material — the planning/packing
+    half of ``group_counts_state``/``group_count_stats``, split out
+    (round 19) so the fused multi-pass dispatch and the per-set paths
+    share one derivation and can never drift. ``keys`` (dense only) is
+    the mixed-radix packed int64 vector with -1 marking excluded rows —
+    exactly what ``_device_bincount`` consumes, offsettable for
+    fusion."""
+
+    columns: Tuple[str, ...]
+    code_arrays: List[np.ndarray]
+    value_arrays: Optional[List[np.ndarray]]
+    radices: List[int]
+    any_non_null: Optional[np.ndarray]
+    num_rows: int
+    keyspace: int
+    dense: bool
+    keys: Optional[np.ndarray]
+
+
+def _prepare_grouping(
+    table: ColumnarTable,
+    columns: Sequence[str],
+    require_any_non_null: bool = True,
+    with_values: bool = True,
+) -> _GroupPrep:
+    """Derive one grouping set's codes/radices/packed keys.
+    ``with_values=False`` skips the typed distinct-value arrays (the
+    count-stats path never decodes group values)."""
+    code_arrays = []
+    value_arrays: Optional[List[np.ndarray]] = [] if with_values else None
+    radices = []
+    for name in columns:
+        col = table[name]
+        codes, values = column_key_codes(col)
+        if with_values:
+            # memoize the typed distinct-value array per column: for
+            # string columns this converts the whole dictionary
+            # (O(cardinality)); repeated runs (incremental monitoring)
+            # reuse it
+            typed = getattr(col, "_typed_distinct", None)
+            if typed is None or len(typed) != len(values):
+                typed = _typed_values(col.dtype, values)
+                col._typed_distinct = typed
+            value_arrays.append(typed)
+        code_arrays.append(codes)
+        radices.append(len(values) + 1)
+
+    if require_any_non_null and len(columns) > 0:
+        any_non_null = np.zeros(table.num_rows, dtype=bool)
+        for codes in code_arrays:
+            any_non_null |= codes > 0
+        num_rows = int(any_non_null.sum())
+    else:
+        any_non_null = None
+        num_rows = table.num_rows
+
+    # Python-int product: mixed-radix packing into int64 silently wraps when
+    # the key space exceeds 2^63, so overflow must be checked BEFORE packing
+    keyspace = 1
+    for radix in radices:
+        keyspace *= radix
+
+    dense = keyspace <= DENSE_KEYSPACE_LIMIT
+    keys = None
+    if dense:
+        keys = np.zeros(table.num_rows, dtype=np.int64)
+        for codes, radix in zip(code_arrays, radices):
+            keys = keys * radix + codes
+        if any_non_null is not None:
+            keys = np.where(any_non_null, keys, -1)
+    return _GroupPrep(
+        tuple(columns), code_arrays, value_arrays, radices, any_non_null,
+        num_rows, keyspace, dense, keys,
+    )
+
+
+def _dense_digits(
+    prep: _GroupPrep, counts: np.ndarray
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Dense counts vector -> (per-column digit codes of the present
+    groups, their counts) via vectorized mixed-radix decode."""
+    present = np.nonzero(counts)[0]
+    group_counts_vec = counts[present].astype(np.int64)
+    digit_cols = []
+    rest = present
+    for radix in reversed(prep.radices):
+        digit_cols.append(rest % radix)
+        rest = rest // radix
+    digit_cols.reverse()
+    return digit_cols, group_counts_vec
+
+
+def _freq_state_from_digits(
+    columns: Sequence[str],
+    digit_cols: List[np.ndarray],
+    group_counts_vec: np.ndarray,
+    value_arrays: List[np.ndarray],
+    num_rows: int,
+    canonicalize: bool,
+):
+    """Digit codes + counts -> columnar ``FrequenciesAndNumRows`` (the
+    finalize half shared by the dense, sparse, and fused paths)."""
+    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
+
+    key_values = []
+    key_nulls = []
+    for digits, values in zip(digit_cols, value_arrays):
+        nulls = digits == 0
+        if len(values):
+            key_values.append(values[np.maximum(digits - 1, 0)])
+        else:
+            key_values.append(np.zeros(len(digits), dtype=values.dtype))
+        key_nulls.append(nulls)
+    if canonicalize:
+        # lazy import: spill depends on analyzers.grouping which imports
+        # this module; at call time everything is loaded
+        from deequ_tpu.spill.order import is_strictly_ascending, merge_add_sorted
+
+        if not is_strictly_ascending(key_values, key_nulls):
+            kv, kn, group_counts_vec = merge_add_sorted(
+                [(tuple(key_values), tuple(key_nulls), group_counts_vec)]
+            )
+            key_values, key_nulls = list(kv), list(kn)
+    return FrequenciesAndNumRows(
+        tuple(columns), tuple(key_values), tuple(key_nulls),
+        group_counts_vec, num_rows,
+    )
+
+
 def group_counts_state(
     table: ColumnarTable,
     columns: Sequence[str],
@@ -576,69 +707,26 @@ def group_counts_state(
     delta is VERIFIED (O(G) adjacent-row compare) and host sort+dedup'd
     only when the order actually fails.
     """
-    from deequ_tpu.analyzers.grouping import FrequenciesAndNumRows
-
     if mesh is None:
         mesh = current_mesh()
     SCAN_STATS.grouping_passes += 1
     SCAN_STATS.rows_scanned += table.num_rows
 
-    code_arrays = []
-    value_arrays = []
-    for name in columns:
-        col = table[name]
-        codes, values = column_key_codes(col)
-        # memoize the typed distinct-value array per column: for string
-        # columns this converts the whole dictionary (O(cardinality));
-        # repeated runs (incremental monitoring) reuse it
-        typed = getattr(col, "_typed_distinct", None)
-        if typed is None or len(typed) != len(values):
-            typed = _typed_values(col.dtype, values)
-            col._typed_distinct = typed
-        code_arrays.append(codes)
-        value_arrays.append(typed)
+    prep = _prepare_grouping(
+        table, columns, require_any_non_null, with_values=True
+    )
 
-    radices = [len(v) + 1 for v in value_arrays]
-
-    if require_any_non_null and len(columns) > 0:
-        any_non_null = np.zeros(table.num_rows, dtype=bool)
-        for codes in code_arrays:
-            any_non_null |= codes > 0
-        num_rows = int(any_non_null.sum())
-    else:
-        any_non_null = None
-        num_rows = table.num_rows
-
-    # Python-int product: mixed-radix packing into int64 silently wraps when
-    # the key space exceeds 2^63, so overflow must be checked BEFORE packing
-    keyspace = 1
-    for radix in radices:
-        keyspace *= radix
-
-    if keyspace <= DENSE_KEYSPACE_LIMIT:
-        keys = np.zeros(table.num_rows, dtype=np.int64)
-        for codes, radix in zip(code_arrays, radices):
-            keys = keys * radix + codes
-        if any_non_null is not None:
-            keys = np.where(any_non_null, keys, -1)
-        counts = _device_bincount(keys, keyspace, mesh)
-        present = np.nonzero(counts)[0]
-        group_counts_vec = counts[present].astype(np.int64)
-        # vectorized mixed-radix decode: packed key -> per-column digits
-        digit_cols = []
-        rest = present
-        for radix in reversed(radices):
-            digit_cols.append(rest % radix)
-            rest = rest // radix
-        digit_cols.reverse()
+    if prep.dense:
+        counts = _device_bincount(prep.keys, prep.keyspace, mesh)
+        digit_cols, group_counts_vec = _dense_digits(prep, counts)
     else:
         # sparse path for huge key spaces: device lexsort + run-length
         # encoding over the code matrix — no packing (no overflow regardless
         # of cardinality product), no host sort
-        matrix = np.stack(code_arrays, axis=0)
+        matrix = np.stack(prep.code_arrays, axis=0)
         valid = (
-            any_non_null
-            if any_non_null is not None
+            prep.any_non_null
+            if prep.any_non_null is not None
             else np.ones(table.num_rows, dtype=bool)
         )
         groups_mat, group_counts_vec = _device_matrix_rle(matrix, valid)
@@ -651,28 +739,9 @@ def group_counts_state(
             digit_cols = [d[order] for d in digit_cols]
             group_counts_vec = group_counts_vec[order]
 
-    key_values = []
-    key_nulls = []
-    for digits, values in zip(digit_cols, value_arrays):
-        nulls = digits == 0
-        if len(values):
-            key_values.append(values[np.maximum(digits - 1, 0)])
-        else:
-            key_values.append(np.zeros(len(digits), dtype=values.dtype))
-        key_nulls.append(nulls)
-    if canonicalize:
-        # lazy import: spill depends on analyzers.grouping which imports
-        # this module; at call time everything is loaded
-        from deequ_tpu.spill.order import is_strictly_ascending, merge_add_sorted
-
-        if not is_strictly_ascending(key_values, key_nulls):
-            kv, kn, group_counts_vec = merge_add_sorted(
-                [(tuple(key_values), tuple(key_nulls), group_counts_vec)]
-            )
-            key_values, key_nulls = list(kv), list(kn)
-    return FrequenciesAndNumRows(
-        tuple(columns), tuple(key_values), tuple(key_nulls),
-        group_counts_vec, num_rows,
+    return _freq_state_from_digits(
+        columns, digit_cols, group_counts_vec, prep.value_arrays,
+        prep.num_rows, canonicalize,
     )
 
 
@@ -856,42 +925,22 @@ def group_count_stats(
                 float(ent) if total > 0 and int(groups) > 0 else float("nan"),
             )
 
-    code_arrays = []
-    radices = []
-    for name in columns:
-        codes, values = column_key_codes(table[name])
-        code_arrays.append(codes)
-        radices.append(len(values) + 1)
+    prep = _prepare_grouping(
+        table, columns, require_any_non_null, with_values=False
+    )
+    num_rows = prep.num_rows
 
-    if require_any_non_null and len(columns) > 0:
-        any_non_null = np.zeros(table.num_rows, dtype=bool)
-        for codes in code_arrays:
-            any_non_null |= codes > 0
-        num_rows = int(any_non_null.sum())
-    else:
-        any_non_null = None
-        num_rows = table.num_rows
-
-    keyspace = 1
-    for radix in radices:
-        keyspace *= radix
-
-    if keyspace <= DENSE_KEYSPACE_LIMIT:
-        keys = np.zeros(table.num_rows, dtype=np.int64)
-        for codes, radix in zip(code_arrays, radices):
-            keys = keys * radix + codes
-        if any_non_null is not None:
-            keys = np.where(any_non_null, keys, -1)
-        counts = _device_bincount(keys, keyspace, mesh)
+    if prep.dense:
+        counts = _device_bincount(prep.keys, prep.keyspace, mesh)
         return _count_stats_from_counts(counts[counts > 0], num_rows)
 
     # sparse path: every aggregate reduces ON DEVICE — only four scalars
     # are fetched, regardless of group count (the former implementation
     # fetched two n-length boolean vectors)
-    matrix = np.stack(code_arrays, axis=0)
+    matrix = np.stack(prep.code_arrays, axis=0)
     valid = (
-        any_non_null
-        if any_non_null is not None
+        prep.any_non_null
+        if prep.any_non_null is not None
         else np.ones(table.num_rows, dtype=bool)
     )
     if table.num_rows <= host_group_limit():
@@ -911,3 +960,211 @@ def group_count_stats(
     else:
         entropy = float("nan")
     return CountStats(num_rows, num_groups, singletons, entropy)
+
+
+# -- cross-pass grouping fusion (round 19, the whole-run plan optimizer) ----
+
+
+@dataclass(frozen=True)
+class GroupRequest:
+    """One grouping pass the plan optimizer may fuse: its sorted column
+    set and which finalize shape the caller needs — ``"freq"`` (the full
+    columnar ``FrequenciesAndNumRows``) or ``"stats"`` (count-distribution
+    scalars only, the ``group_count_stats`` fast path)."""
+
+    columns: Tuple[str, ...]
+    mode: str = "freq"
+    canonicalize: bool = False
+
+
+def _resident_stats_eligible(table, columns, mesh) -> bool:
+    """True when a stats-mode set would take ``group_count_stats``'s
+    resident-string fast path (all four aggregates from HBM-resident
+    codes, four scalars fetched) — cheaper than any fusion, and its
+    device-side entropy reduction is not bit-guaranteed against the host
+    finalize, so the optimizer must leave such sets on the per-set
+    path."""
+    if len(columns) != 1 or table[columns[0]].dtype != DType.STRING:
+        return False
+    cache = getattr(table, "_device_cache", None)
+    if cache is None or not cache.device_chunks:
+        return False
+    if not cache.matches(mesh, [columns[0]]):
+        return False
+    return columns[0] in cache.packer.string_names
+
+
+def _maybe_lint_fused(
+    keyspaces: Tuple[int, ...], n: int, mesh, variant: str
+) -> None:
+    """Static lint of the fused multi-pass bincount program under the
+    ambient DEEQU_TPU_PLAN_LINT mode — the ``plan-fusion-refetch`` rule
+    armed against the exact jitted program the dispatch will run (one
+    concatenated counts output, no host callbacks). Memoized under the
+    fusion signature so fused and unfused variants of the same sets lint
+    separately, and repeated fused dispatches add zero traces."""
+    from deequ_tpu.lint.plan_lint import (
+        enforce_plan_lint,
+        lint_plan_cached,
+        plan_lint_mode,
+    )
+
+    mode = plan_lint_mode(None)
+    if mode == "off":
+        return
+    from deequ_tpu.ops.scan_plan import plan_fused_grouping
+
+    total = sum(keyspaces)
+    plan_ir = plan_fused_grouping(keyspaces, rows=n, hist_variant=variant)
+    fn = _bincount_fn(total, mesh, variant)
+    avals = (jax.ShapeDtypeStruct((int(n),), np.int64),)
+    mesh_sig = (
+        None
+        if mesh is None
+        else tuple(int(d.id) for d in np.ravel(mesh.devices))
+    )
+    memo_key = ("fused_group", keyspaces, int(n), variant, mesh_sig)
+    findings, traced = lint_plan_cached(plan_ir, fn, avals, memo_key)
+    if traced:
+        SCAN_STATS.plan_lint_traces += 1
+    if findings:
+        SCAN_STATS.plan_lints.extend(f.as_dict() for f in findings)
+    enforce_plan_lint(findings, mode)
+
+
+def fused_group_counts(
+    table: ColumnarTable,
+    requests: Sequence[GroupRequest],
+    mesh=None,
+) -> Dict[int, object]:
+    """Cross-pass grouping FUSION: execute several dense grouping passes
+    in ONE device dispatch (round 19, the tentpole observable — K
+    grouping passes, one ``record_hist_dispatch``, one fetch).
+
+    Each dense-eligible request's packed keys are offset by the
+    cumulative keyspace of the requests fused before it and concatenated
+    into one key vector; a single ``_device_bincount`` over the summed
+    keyspace then counts every sub-pass at once, and the counts vector
+    slices back per request. Integer bincounts are exact under any
+    kernel variant or concatenation order, so each slice is bit-identical
+    to the counts the per-set dispatch would have produced — the fusion
+    legality rule (docs/planner.md).
+
+    Returns ``{request_index: state}`` for the requests computed here
+    (``FrequenciesAndNumRows`` for freq mode, ``CountStats`` for stats
+    mode), with per-request ``grouping_passes``/``rows_scanned``
+    accounting identical to the per-set path. A request ABSENT from the
+    result falls back to the ordinary per-set path: sparse keyspaces,
+    resident-string stats sets, sets whose preparation failed (the
+    per-set path re-raises into the analyzer's failure metric), and sets
+    whose fused group faulted twice.
+
+    Fault ladder: a typed device fault (or an armed plan-lint rejection)
+    during the FUSED dispatch demotes that group — recorded as a
+    ``fusion_demote`` degradation — and each member re-plans UNFUSED
+    from its own prepared keys, exactly the re-plan-per-attempt contract
+    the scan ladder keeps."""
+    from deequ_tpu.exceptions import DeviceException, PlanLintError
+
+    if mesh is None:
+        mesh = current_mesh()
+
+    preps: Dict[int, _GroupPrep] = {}
+    for i, req in enumerate(requests):
+        if req.mode == "stats" and _resident_stats_eligible(
+            table, req.columns, mesh
+        ):
+            continue
+        try:
+            prep = _prepare_grouping(
+                table, list(req.columns), True,
+                with_values=req.mode == "freq",
+            )
+        # deequ-lint: ignore[bare-except] -- a failed preparation falls back to the per-set path, which re-raises into the analyzer's typed failure metric
+        except Exception:  # noqa: BLE001
+            continue
+        if prep.dense:
+            preps[i] = prep
+
+    # greedy keyspace packing: fuse runs of dense sets whose SUMMED
+    # counts vector still fits the dense limit (the fused dispatch
+    # materializes one vector of the total width)
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_space = 0
+    for i in sorted(preps):
+        k = preps[i].keyspace
+        if cur and cur_space + k > DENSE_KEYSPACE_LIMIT:
+            groups.append(cur)
+            cur, cur_space = [], 0
+        cur.append(i)
+        cur_space += k
+    if cur:
+        groups.append(cur)
+
+    results: Dict[int, object] = {}
+    for group in groups:
+        group_counts: Optional[List[np.ndarray]] = None
+        if len(group) >= 2:
+            keyspaces = tuple(preps[i].keyspace for i in group)
+            total = sum(keyspaces)
+            offsets = np.cumsum((0,) + keyspaces[:-1])
+            combined = np.concatenate([
+                np.where(
+                    preps[i].keys >= 0,
+                    preps[i].keys + np.int64(off),
+                    np.int64(-1),
+                )
+                for i, off in zip(group, offsets)
+            ])
+            try:
+                if len(combined) > host_group_limit():
+                    from deequ_tpu.ops.device_policy import (
+                        resolve_hist_variant,
+                    )
+
+                    variant = resolve_hist_variant(
+                        (total + 1,), rows=len(combined)
+                    )
+                    _maybe_lint_fused(
+                        keyspaces, len(combined), mesh, variant
+                    )
+                all_counts = _device_bincount(combined, total, mesh)
+                group_counts = [
+                    all_counts[off:off + k]
+                    for off, k in zip(offsets, keyspaces)
+                ]
+                SCAN_STATS.record_fused_group_pass(len(group))
+            except (DeviceException, PlanLintError) as e:
+                # the demotion rung: re-plan each member UNFUSED below
+                SCAN_STATS.record_degradation(
+                    "fusion_demote", passes=len(group),
+                    keyspace=int(total), reason=str(e),
+                )
+                group_counts = None
+        for j, i in enumerate(group):
+            req, prep = requests[i], preps[i]
+            try:
+                counts = (
+                    group_counts[j]
+                    if group_counts is not None
+                    else _device_bincount(prep.keys, prep.keyspace, mesh)
+                )
+                if req.mode == "stats":
+                    state = _count_stats_from_counts(
+                        counts[counts > 0], prep.num_rows
+                    )
+                else:
+                    digit_cols, vec = _dense_digits(prep, counts)
+                    state = _freq_state_from_digits(
+                        req.columns, digit_cols, vec, prep.value_arrays,
+                        prep.num_rows, req.canonicalize,
+                    )
+            # deequ-lint: ignore[bare-except] -- an unfused retry that still fails falls back to the per-set path for its typed failure metric
+            except Exception:  # noqa: BLE001
+                continue
+            # per-request census parity with the per-set path
+            SCAN_STATS.grouping_passes += 1
+            SCAN_STATS.rows_scanned += table.num_rows
+            results[i] = state
+    return results

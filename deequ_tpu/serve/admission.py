@@ -210,11 +210,18 @@ class BrownoutController:
         ordered = sorted(v for _, v in self._lat)
         return ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
 
-    def update(self, depth: int) -> int:
-        """Recompute + return the level for the current queue depth."""
+    def update(self, depth: int, cost_frac: Optional[float] = None) -> int:
+        """Recompute + return the level for the current queue depth.
+        ``cost_frac`` (round 19) is queued PREDICTED COST over cost
+        capacity (ops/plan_cost.py via the admission controller): the
+        ladder reads the max of the two pressures, so a queue of few but
+        heavy suites browns out as early as a deep queue of light ones —
+        thresholds derived from predicted plan cost, not depth alone."""
         if not self.enabled:
             return 0
         frac = depth / self.capacity
+        if cost_frac is not None:
+            frac = max(frac, float(cost_frac))
         target = 0
         for i, threshold in enumerate(self.up):
             if frac >= threshold:
@@ -288,20 +295,68 @@ class AdmissionController:
         # drain-rate EWMA behind retry_after (suites/s; None until the
         # first served batch reports in)
         self._rate: Optional[float] = None
+        # cost-drain EWMAs (round 19, ops/plan_cost.py): cost units/s
+        # served, and cost units per suite — retry_after prices the
+        # QUEUED COST when the service feeds it, and the brownout
+        # ladder's cost pressure normalizes against avg-cost * capacity
+        self._cost_rate: Optional[float] = None
+        self._avg_cost: Optional[float] = None
 
-    def note_served(self, n: int, wall_seconds: float) -> None:
-        """Feed the drain-rate estimate (called per served batch)."""
+    def note_served(
+        self, n: int, wall_seconds: float, cost: Optional[float] = None,
+    ) -> None:
+        """Feed the drain-rate estimate (called per served batch).
+        ``cost`` is the batch's summed PREDICTED cost (plan-cost units);
+        with it the controller also learns cost/s and cost/suite."""
         if n <= 0 or wall_seconds <= 0:
             return
         rate = n / wall_seconds
         self._rate = (
             rate if self._rate is None else 0.8 * self._rate + 0.2 * rate
         )
+        if cost is not None and cost > 0:
+            crate = cost / wall_seconds
+            self._cost_rate = (
+                crate if self._cost_rate is None
+                else 0.8 * self._cost_rate + 0.2 * crate
+            )
+            per_suite = cost / n
+            self._avg_cost = (
+                per_suite if self._avg_cost is None
+                else 0.8 * self._avg_cost + 0.2 * per_suite
+            )
 
-    def retry_after(self, queue_depth: int) -> float:
-        """When a refused caller could plausibly be admitted: the time
-        to drain the current queue at the observed rate (bounded), or a
-        small constant before any rate is known."""
+    def cost_fraction(self, queued_cost: Optional[float]) -> Optional[float]:
+        """Queued predicted cost over cost CAPACITY (avg suite cost x
+        max_pending) — the brownout ladder's second pressure feed. None
+        until both a queued-cost ledger and a served-cost average
+        exist."""
+        if (
+            queued_cost is None
+            or self._avg_cost is None
+            or self._avg_cost <= 0
+        ):
+            return None
+        return float(queued_cost) / (self._avg_cost * self.max_pending)
+
+    def retry_after(
+        self, queue_depth: int, queued_cost: Optional[float] = None,
+    ) -> float:
+        """When a refused caller could plausibly be admitted. With a
+        queued-cost ledger and an observed cost-drain rate (round 19),
+        the schedule is time-to-drain the queued PREDICTED COST — a
+        queue of heavy profiling suites schedules a later retry than the
+        same depth of trivial checks; otherwise the legacy depth/rate
+        estimate (bounded), or a small constant before any rate is
+        known."""
+        if (
+            queued_cost is not None
+            and self._cost_rate is not None
+            and self._cost_rate > 0
+        ):
+            return min(
+                30.0, max(0.005, float(queued_cost) / self._cost_rate)
+            )
         if self._rate is None or self._rate <= 0:
             return 0.05
         return min(30.0, max(0.005, (queue_depth + 1) / self._rate))
@@ -313,22 +368,28 @@ class AdmissionController:
         queue_depth: int,
         class_depth: int,
         tenant_pending: int,
+        queued_cost: Optional[float] = None,
     ) -> int:
         """Admit or raise typed. Returns the brownout level applied.
         ``class_depth`` is the queued count of ``slo.cls``;
         ``tenant_pending`` the tenant's queued count (the level-2 cap's
-        subject). The caller (the service, under its queue lock)
-        supplies the depths so decision and enqueue are atomic."""
+        subject); ``queued_cost`` the queue's summed PREDICTED plan cost
+        (round 19 — drives cost-aware ``retry_after_s`` and the
+        brownout ladder's cost pressure). The caller (the service, under
+        its queue lock) supplies the depths so decision and enqueue are
+        atomic."""
         from deequ_tpu.obs.registry import (
             SERVE_ADMISSION_REJECTED_BY_CLASS,
             SERVE_ADMITTED_BY_CLASS,
         )
 
         level = (
-            self.brownout.update(queue_depth)
+            self.brownout.update(
+                queue_depth, cost_frac=self.cost_fraction(queued_cost)
+            )
             if self.brownout is not None else 0
         )
-        retry = self.retry_after(queue_depth)
+        retry = self.retry_after(queue_depth, queued_cost=queued_cost)
 
         def refuse(exc):
             SERVE_ADMISSION_REJECTED_BY_CLASS[slo.cls].inc()

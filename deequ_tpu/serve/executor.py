@@ -181,12 +181,19 @@ def stack_luts(plan, tables: Sequence, k_bucket: int):
     return lut_stacked, lut_sig
 
 
-def _build_packed_program(plan, lut_keys: Tuple[str, ...]):
+def _build_packed_program(plan, lut_keys: Tuple[str, ...], op_order=None):
     """Trace the shared single-member flat step and vmap it over the
     tenant axis — the run_scan_group program shape, built from the
-    plan's metadata-only unpack view (never pinning member tables)."""
+    plan's metadata-only unpack view (never pinning member tables).
+    ``op_order`` (round 19) traces the ops in CANONICAL order so the
+    program is shareable across suites below the exact PlanKey; the
+    caller permutes results back to exec order."""
     view = plan.unpack_view
-    ops = plan.exec_ops
+    ops = (
+        plan.exec_ops
+        if op_order is None
+        else tuple(plan.exec_ops[i] for i in op_order)
+    )
     chunk = plan.key.chunk
 
     def single_tree(values, hi, lo, narrow_i, masks, codes, row_valid, enc, luts):
@@ -258,13 +265,20 @@ def packed_lint_memo_key(plan, k_bucket: int, lut_sig, members) -> Tuple:
     """The packed program's OWN lint memo identity: tenant-axis bucket +
     per-member contract fingerprints on top of the plan fingerprint —
     a packed plan never inherits its single-tenant twin's verdict, and a
-    batch with different member contracts lints fresh."""
+    batch with different member contracts lints fresh. The canonical op
+    ordering (round 19: the traced program runs ops in shareable
+    canonical order, not submission order) rides in the key too, so a
+    verdict memoized against the canonical program can never be replayed
+    against a differently-ordered one."""
+    from deequ_tpu.serve.plan_cache import canonical_op_order
+
+    canon, _ = canonical_op_order(getattr(plan, "exec_ops", ()))
     member_fp = tuple(
         (m.label if m.padding else "", m.variant, m.ingest_variant,
          m.encoded_columns, m.padding)
         for m in members
     )
-    return ("packed", plan.key, k_bucket, lut_sig, member_fp)
+    return ("packed", plan.key, canon, k_bucket, lut_sig, member_fp)
 
 
 def run_coalesced(
@@ -348,9 +362,35 @@ def run_coalesced(
         layout=layout_signature(plan.layout),
     )
 
+    from deequ_tpu.serve.plan_cache import (
+        SUBPLAN_CACHE,
+        canonical_op_order,
+        subplan_key,
+    )
+
+    # programs are traced in CANONICAL op order (round 19) so suites
+    # that dedupe to the same op set — permuted submissions included —
+    # share ONE traced program below the exact PlanKey; `perm` maps
+    # canonical result positions back to this plan's exec order
+    canon, perm = canonical_op_order(plan.exec_ops)
+    sub_key = subplan_key(
+        plan, canon, k_bucket, lut_sig,
+        base_ir.variant, base_ir.hist_variant,
+        "encoded" if enc_cols else "decoded",
+    )
+    if plan_lint != "off":
+        # the sharing half of plan-fusion-refetch: a sub-plan key that
+        # dropped an identity component would alias different programs
+        from deequ_tpu.lint.plan_lint import check_subplan_key
+
+        key_findings = check_subplan_key(sub_key)
+        if key_findings:
+            SCAN_STATS.plan_lints.extend(f.as_dict() for f in key_findings)
+            enforce_plan_lint(key_findings, plan_lint)
+
     cached = plan.program_for(k_bucket, lut_sig)
     if cached is not None:
-        single_flat, vstep, shapes, recipes = cached
+        single_flat, vstep, shapes, recipes, perm = cached
         SCAN_STATS.programs_reused += 1
         # suite-weighted ledger: every member of this batch was served
         # from the compiled-plan cache (zero builds/traces/compiles/lint
@@ -358,23 +398,41 @@ def run_coalesced(
         # from cache", the serving-layer observable
         SCAN_STATS.plan_cache_hits += K
     else:
-        SCAN_STATS.programs_built += 1
-        SCAN_STATS.plan_cache_misses += K
-        _tree, single_flat, vstep = _build_packed_program(
-            plan, tuple(sorted(lut_host))
-        )
-        shapes = device_call(
-            lambda: jax.eval_shape(
-                _tree,
-                *(b[0] for b in bufs),
-                {k: v[0] for k, v in lut_host.items()},
-            ),
-            "trace", what="packed scan trace", deadline=device_deadline,
-        )
-        recipes = _unflatten_recipe(shapes)
-        plan.put_program(
-            k_bucket, lut_sig, (single_flat, vstep, shapes, recipes)
-        )
+        shared = SUBPLAN_CACHE.get(sub_key)
+        if shared is not None:
+            # cross-suite hit: another PlanKey already traced this
+            # canonical program at this (bucket, LUT) shape — adopt it
+            # with our own exec-order permutation, zero traces paid
+            single_flat, vstep, shapes, recipes = shared
+            SCAN_STATS.programs_reused += 1
+            SCAN_STATS.plan_cache_hits += K
+            SCAN_STATS.record_subplan_hit(K)
+            plan.put_program(
+                k_bucket, lut_sig,
+                (single_flat, vstep, shapes, recipes, perm),
+            )
+        else:
+            SCAN_STATS.programs_built += 1
+            SCAN_STATS.plan_cache_misses += K
+            _tree, single_flat, vstep = _build_packed_program(
+                plan, tuple(sorted(lut_host)), op_order=canon
+            )
+            shapes = device_call(
+                lambda: jax.eval_shape(
+                    _tree,
+                    *(b[0] for b in bufs),
+                    {k: v[0] for k, v in lut_host.items()},
+                ),
+                "trace", what="packed scan trace", deadline=device_deadline,
+            )
+            recipes = _unflatten_recipe(shapes)
+            plan.put_program(
+                k_bucket, lut_sig,
+                (single_flat, vstep, shapes, recipes, perm),
+            )
+            SUBPLAN_CACHE.put(
+                sub_key, (single_flat, vstep, shapes, recipes)
+            )
 
     # packed plan lint BEFORE dispatch, memoized under the packed key:
     # a cache-hit batch (plan + program + lint verdict all memoized)
@@ -455,7 +513,10 @@ def run_coalesced(
     )
     out: List[List[Any]] = []
     for k in range(K):  # padding slices [K:] are discarded
-        out.append(_unflatten_member(host[k], recipes))
+        canonical = _unflatten_member(host[k], recipes)
+        # the program computed ops in canonical (shareable) order;
+        # callers consume exec-op order — permute back
+        out.append([canonical[perm[i]] for i in range(len(canonical))])
     SCAN_STATS.chunks_processed += K
     SCAN_STATS.scan_seconds += time.time() - t_start
     return out

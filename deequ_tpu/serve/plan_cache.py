@@ -33,6 +33,7 @@ suite is a hit and adds zero ``plan_lint_traces`` / ``programs_built``.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -128,6 +129,109 @@ class PlanCache:
 
     def clear(self) -> None:
         self._lru.clear()
+
+
+@dataclass(frozen=True)
+class SubPlanKey:
+    """Cross-suite SUB-PLAN identity (round 19, the plan optimizer):
+    the traced packed program's identity BELOW the exact :class:`PlanKey`.
+
+    Two tenants whose analyzer sets are permutations (or whose suites
+    dedupe to the same op set) get DISTINCT PlanKeys — ``analyzer_sig``
+    preserves submission order, which the result path needs — but trace
+    to the same program once ops are put in canonical order. This key
+    names that shared program: the canonical (sorted by op identity)
+    exec-op tuple, the schema/layout signatures, the chunk width, the
+    tenant bucket + LUT signature (the traced shapes), and every kernel
+    variant that steers codegen. ``lint.plan_lint.check_subplan_key``
+    (the ``plan-fusion-refetch`` rule's sharing half) rejects any key
+    that drops an identity component."""
+
+    ops_sig: Tuple
+    schema_sig: Tuple
+    layout_sig: Tuple
+    chunk: int
+    k_bucket: int
+    lut_sig: Tuple
+    variant: str
+    hist_variant: str
+    ingest_variant: str
+
+
+class SubPlanCache:
+    """Bounded LRU of traced packed programs keyed by
+    :class:`SubPlanKey` — lock-serialized (the serving workers share the
+    process singleton, like the PR-14 census counters). Stored entries
+    are (single_flat, vstep, shapes, recipes) in CANONICAL op order;
+    each borrowing plan keeps its own exec-order permutation alongside
+    its ``ServePlan.programs`` entry."""
+
+    def __init__(self, cap: int = 128):
+        self._lru = _BoundedLRU(cap)
+        self._lock = threading.Lock()
+
+    def get(self, key: SubPlanKey):
+        with self._lock:
+            return self._lru.get(key)
+
+    def put(self, key: SubPlanKey, prog) -> None:
+        with self._lock:
+            self._lru.put(key, prog)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+
+
+#: the process-wide cross-suite program cache (serve/executor.py reads
+#: it on every exact-PlanKey program miss before paying a trace)
+SUBPLAN_CACHE = SubPlanCache()
+
+
+def canonical_op_order(exec_ops: Tuple) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """The canonical op ordering shared programs are traced in: exec-op
+    indices sorted by the op's cache-key identity (analyzers are value
+    objects; their string form is a stable total order for any one op
+    set). Returns ``(canon, perm)`` — ``canon[pos]`` is the exec index
+    at canonical position ``pos``, and ``perm[exec_idx]`` the canonical
+    position of exec op ``exec_idx`` (the result-path inverse)."""
+    canon = tuple(
+        sorted(range(len(exec_ops)), key=lambda i: str(exec_ops[i].cache_key))
+    )
+    perm = [0] * len(canon)
+    for pos, i in enumerate(canon):
+        perm[i] = pos
+    return canon, tuple(perm)
+
+
+def subplan_key(
+    plan: ServePlan,
+    canon: Tuple[int, ...],
+    k_bucket: int,
+    lut_sig: Tuple,
+    variant: str,
+    hist_variant: str,
+    ingest_variant: str,
+) -> SubPlanKey:
+    """Build the :class:`SubPlanKey` for ``plan``'s packed program at
+    this (bucket, LUT) shape. ``ops_sig`` carries the analyzer value
+    objects themselves (full identity: parameters and ``where``
+    predicates included), in canonical order."""
+    return SubPlanKey(
+        ops_sig=tuple(plan.exec_ops[i].cache_key for i in canon),
+        schema_sig=plan.key.schema_sig,
+        layout_sig=layout_signature(plan.layout),
+        chunk=plan.key.chunk,
+        k_bucket=k_bucket,
+        lut_sig=lut_sig,
+        variant=variant,
+        hist_variant=hist_variant,
+        ingest_variant=ingest_variant,
+    )
 
 
 def schema_signature(table, needed) -> Tuple:

@@ -272,6 +272,10 @@ class ServeRequest:
     #: plan key) — reused by the executor so members pack without a
     #: second classification pass
     packer: Any = None
+    #: PREDICTED plan cost (ops/plan_cost.py units, stamped at submit):
+    #: feeds the queued-cost ledger behind cost-aware retry_after_s and
+    #: the brownout ladder's cost pressure (round 19)
+    predicted_cost: float = 0.0
 
 
 class _TenantHealth:
@@ -427,6 +431,11 @@ class VerificationService:
         self._thread: Optional[threading.Thread] = None
         self.batches_served = 0
         self.suites_served = 0
+        # queued PREDICTED-cost ledger (ops/plan_cost.py units, round
+        # 19): summed predicted_cost of every queued request, mutated
+        # only under self._cv alongside the queue itself — the feed
+        # behind cost-aware retry_after_s and brownout cost pressure
+        self._queued_cost = 0.0
         if start:
             self.start()
 
@@ -461,6 +470,7 @@ class VerificationService:
             self._closed = True
             self._running = False
             pending = self._queue.drain()
+            self._queued_cost = 0.0
             self._cv.notify_all()
         if join and self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=30.0)
@@ -485,6 +495,9 @@ class VerificationService:
                 # donor's
                 req.future._on_done = self._observe_done
                 self._queue.push(req)
+                self._queued_cost += float(
+                    getattr(req, "predicted_cost", 0.0) or 0.0
+                )
             self._cv.notify_all()
 
     def inject_stall(self, seconds: float) -> None:
@@ -566,6 +579,22 @@ class VerificationService:
         slo = resolve_slo(
             slo if slo is not None else self.config.default_slo
         )
+        # price the suite BEFORE taking the queue lock (the estimate
+        # walks the analyzer list): the predicted cost feeds the
+        # queued-cost ledger, cost-aware retry_after_s, and the
+        # brownout ladder's cost pressure (ops/plan_cost.py, round 19)
+        from deequ_tpu.ops.plan_cost import PLAN_COST_MODEL
+
+        try:
+            suite_analyzers = list(required_analyzers)
+            for check in checks:
+                suite_analyzers.extend(check.required_analyzers())
+            predicted_cost = PLAN_COST_MODEL.estimate_suite(
+                suite_analyzers, int(getattr(data, "num_rows", 0) or 0)
+            ).total
+        # deequ-lint: ignore[bare-except] -- an unpriceable suite admits under the legacy depth-only signals; pricing must never refuse work
+        except Exception:  # noqa: BLE001
+            predicted_cost = 0.0
         future = VerificationFuture(tenant)
         future._on_done = self._observe_done
         req = ServeRequest(
@@ -583,6 +612,7 @@ class VerificationService:
                 future.submitted_at + slo.deadline_seconds
                 if slo.deadline_seconds is not None else None
             ),
+            predicted_cost=predicted_cost,
         )
         with self._cv:
             # a not-yet-started service accepts work (it queues until
@@ -601,8 +631,10 @@ class VerificationService:
                 queue_depth=depth,
                 class_depth=self._queue.class_depth(slo.cls),
                 tenant_pending=self._queue.tenant_depth(tenant),
+                queued_cost=self._queued_cost + predicted_cost,
             )
             self._queue.push(req)
+            self._queued_cost += predicted_cost
             # accounting AFTER the enqueue succeeded but BEFORE the
             # worker is notified: SERVE_SUBMITTED means "accepted" (a
             # typed closed/overload/admission refusal above must not
@@ -765,16 +797,36 @@ class VerificationService:
             SERVE_QUEUE_DEPTH.set(len(self._queue))
             # drain-side ladder update: levels come back DOWN while the
             # worker empties the queue even if nobody submits
-            self._brownout.update(len(self._queue))
+            self._brownout.update(
+                len(self._queue),
+                cost_frac=self._admission.cost_fraction(self._queued_cost),
+            )
             now = time.monotonic()
             while len(self._queue) and len(out) < cfg.max_batch:
                 req = self._queue.pop(now, shed.append)
                 if req is None:
                     break
                 out.append(req)
+            # the ledger tracks QUEUED cost only: both a pop (about to
+            # serve) and a shed (about to resolve typed) leave the queue
+            for req in out:
+                self._queued_cost -= float(
+                    getattr(req, "predicted_cost", 0.0) or 0.0
+                )
+            for req in shed:
+                self._queued_cost -= float(
+                    getattr(req, "predicted_cost", 0.0) or 0.0
+                )
+            # an empty queue pins the ledger to exactly zero (float
+            # subtraction drift must not accumulate across batches)
+            if not len(self._queue) or self._queued_cost < 0.0:
+                self._queued_cost = 0.0
             # post-pop update: this batch may have taken the whole
             # backlog, and the level should reflect what REMAINS
-            self._brownout.update(len(self._queue))
+            self._brownout.update(
+                len(self._queue),
+                cost_frac=self._admission.cost_fraction(self._queued_cost),
+            )
         for req in shed:
             self._shed_expired(req)
         return out
@@ -856,9 +908,16 @@ class VerificationService:
         self.batches_served += 1
         self.suites_served += len(alive)
         # the drain-rate feed behind retry_after: refused callers are
-        # told when the queue will plausibly have drained at this rate
+        # told when the queue will plausibly have drained at this rate.
+        # The summed predicted cost turns that into a COST-drain rate,
+        # so a heavy backlog schedules later retries than a shallow one
+        # of the same depth (ops/plan_cost.py)
         self._admission.note_served(
-            len(alive), time.monotonic() - batch_t0
+            len(alive), time.monotonic() - batch_t0,
+            cost=sum(
+                float(getattr(r, "predicted_cost", 0.0) or 0.0)
+                for r in alive
+            ),
         )
 
     def _admit(self, req: ServeRequest) -> None:

@@ -1,0 +1,585 @@
+"""Whole-run plan-optimizer suite (round 19, the ``plan`` marker).
+
+Covers the four optimizer tiers end to end:
+
+- cross-pass grouping FUSION (ops/segment.fused_group_counts): K dense
+  grouping passes in ONE device dispatch, bit-identical per analyzer
+  family to the per-set path and to ``DEEQU_TPU_PLAN_FUSION=0``;
+- the fusion FAULT rung: a device OOM mid-fused-group demotes to
+  per-set re-plans (``fusion_demote`` degradation) that stay
+  bit-identical — the re-plan-per-attempt contract;
+- cross-suite SUB-PLAN sharing (serve/plan_cache.SUBPLAN_CACHE):
+  permuted tenant suites below distinct exact plan keys share one
+  traced program, counted by ``subplan_cache_hits``;
+- the plan COST MODEL (ops/plan_cost.py): monotonicity in every
+  feature, the ``DEEQU_TPU_HIST_CPU_CAP``/``ACCEL_CAP`` knobs, and
+  cost-priced ``retry_after_s`` ordering in admission — held under the
+  chaos ``load`` seam at zero oracle violations;
+- the ``plan-fusion-refetch`` lint rule drift sims (positive AND
+  negative) plus the sub-plan-key identity check.
+"""
+
+import glob
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers.grouping import (
+    CountDistinct,
+    Distinctness,
+    Entropy,
+    Uniqueness,
+    UniqueValueRatio,
+)
+from deequ_tpu.analyzers.runner import AnalysisRunner
+from deequ_tpu.analyzers.scan import Completeness, Mean, Minimum
+from deequ_tpu.data.table import Column, ColumnarTable, DType
+from deequ_tpu.ops import segment
+from deequ_tpu.ops.plan_cost import (
+    PLAN_COST_MODEL,
+    PlanCostModel,
+    PlanFeatures,
+)
+from deequ_tpu.ops.scan_engine import SCAN_STATS
+from deequ_tpu.ops.segment import GroupRequest, fused_group_counts
+from deequ_tpu.parallel.mesh import use_mesh
+from deequ_tpu.serve import VerificationService
+from deequ_tpu.serve.admission import AdmissionController, BrownoutController
+from deequ_tpu.serve.plan_cache import SUBPLAN_CACHE
+
+pytestmark = pytest.mark.plan
+
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(__file__), "fixtures", "chaos", "load"
+)
+
+
+def _bits(x) -> bytes:
+    return struct.pack("<d", float(x))
+
+
+def _grouping_table(n=512, seed=0) -> ColumnarTable:
+    r = np.random.default_rng(seed)
+    return ColumnarTable([
+        Column("a", DType.INTEGRAL,
+               values=r.integers(0, 40, n).astype(np.float64),
+               mask=r.random(n) > 0.05),
+        Column("b", DType.INTEGRAL,
+               values=r.integers(0, 9, n).astype(np.float64),
+               mask=np.ones(n, bool)),
+        Column("c", DType.FRACTIONAL,
+               values=np.round(r.normal(0, 2, n), 1),
+               mask=r.random(n) > 0.02),
+    ])
+
+
+def _hist_dispatches() -> int:
+    return (
+        SCAN_STATS.hist_scatter_dispatches
+        + SCAN_STATS.hist_onehot_dispatches
+        + SCAN_STATS.hist_pallas_dispatches
+    )
+
+
+def _assert_freq_state_identical(got, want, label):
+    assert np.array_equal(got.key_values, want.key_values), label
+    assert np.array_equal(got.key_nulls, want.key_nulls), label
+    assert np.array_equal(got.counts, want.counts), label
+    assert got.num_rows == want.num_rows, label
+    assert tuple(got.columns) == tuple(want.columns), label
+
+
+@pytest.fixture
+def single_device():
+    with use_mesh(None):
+        yield
+
+
+# -- cross-pass fusion: one dispatch, bit-identity ---------------------------
+
+
+def test_fused_group_counts_one_dispatch_bit_identical(
+    single_device, monkeypatch
+):
+    """K=3 dense grouping passes fuse into ONE bincount dispatch with
+    ONE counts fetch; every slice is bit-identical (exact integer
+    equality, not tolerance) to the per-set dispatch."""
+    monkeypatch.setenv("DEEQU_TPU_HOST_GROUP_LIMIT", "1")
+    table = _grouping_table()
+    requests = [
+        GroupRequest(("a",)),
+        GroupRequest(("b",)),
+        GroupRequest(("a", "b")),
+    ]
+    # reference: the per-set path, one dispatch each
+    want = {
+        i: segment.group_counts_state(table, list(req.columns))
+        for i, req in enumerate(requests)
+    }
+    unfused_dispatches = _hist_dispatches()
+    assert unfused_dispatches == len(requests)
+
+    SCAN_STATS.reset()
+    got = fused_group_counts(table, requests)
+    assert sorted(got) == [0, 1, 2]
+    assert _hist_dispatches() == 1, "fusion must make ONE dispatch"
+    assert SCAN_STATS.fused_group_passes == len(requests)
+    assert SCAN_STATS.grouping_passes == len(requests), (
+        "census parity: each fused sub-pass still counts as one "
+        "grouping pass"
+    )
+    for i in got:
+        _assert_freq_state_identical(got[i], want[i], f"set {i}")
+
+
+def test_fused_stats_mode_bit_identical(single_device, monkeypatch):
+    """Stats-mode requests (count-distribution aggregates) ride the same
+    fused dispatch and match group_count_stats field for field."""
+    monkeypatch.setenv("DEEQU_TPU_HOST_GROUP_LIMIT", "1")
+    table = _grouping_table(seed=3)
+    requests = [GroupRequest(("a",), mode="stats"),
+                GroupRequest(("b",), mode="stats")]
+    want = {
+        i: segment.group_count_stats(table, list(req.columns))
+        for i, req in enumerate(requests)
+    }
+    SCAN_STATS.reset()
+    got = fused_group_counts(table, requests)
+    assert _hist_dispatches() == 1
+    for i in got:
+        for f in ("num_rows", "num_groups", "singletons"):
+            assert getattr(got[i], f) == getattr(want[i], f), (i, f)
+        assert _bits(got[i].entropy) == _bits(want[i].entropy), i
+
+
+def test_runner_fusion_bit_identical_to_unfused(
+    single_device, monkeypatch, df_with_unique_columns
+):
+    """The runner-level A/B the bench probe automates: the same grouping
+    analyzer family under fusion and under DEEQU_TPU_PLAN_FUSION=0
+    yields bit-identical metrics, and only the fused run records fused
+    group passes."""
+    analyzers = [
+        Uniqueness(("nonUnique",)),
+        UniqueValueRatio(("halfUniqueCombinedWithNonUnique",)),
+        Distinctness(("unique",)),
+        Entropy("nonUnique"),
+        CountDistinct(("onlyUniqueWithOtherNonUnique",)),
+    ]
+    monkeypatch.setenv("DEEQU_TPU_PLAN_FUSION", "0")
+    base = AnalysisRunner.do_analysis_run(df_with_unique_columns, analyzers)
+    assert SCAN_STATS.fused_group_passes == 0
+
+    SCAN_STATS.reset()
+    monkeypatch.setenv("DEEQU_TPU_PLAN_FUSION", "1")
+    fused = AnalysisRunner.do_analysis_run(df_with_unique_columns, analyzers)
+    # Uniqueness and Entropy share the nonUnique grouping set: 4 fused
+    # sub-passes serve the 5 analyzers
+    assert SCAN_STATS.fused_group_passes == 4
+    for a in analyzers:
+        m0, m1 = base.metric_map[a], fused.metric_map[a]
+        assert m0.value.is_success and m1.value.is_success, str(a)
+        assert _bits(m0.value.get()) == _bits(m1.value.get()), (
+            f"{a}: unfused={m0.value.get()!r} fused={m1.value.get()!r}"
+        )
+
+
+@pytest.mark.parametrize("encoded", [False, True], ids=["decoded", "encoded"])
+def test_mixed_family_suite_bit_identical_under_fusion(
+    single_device, monkeypatch, encoded
+):
+    """Fusion must not perturb the OTHER analyzer families riding the
+    same run: a mixed monoid + sketch (HLL) + quantile (KLL) + grouping
+    suite — over decoded AND encoded ingest — is bit-identical fused vs
+    DEEQU_TPU_PLAN_FUSION=0."""
+    from deequ_tpu.analyzers import ApproxCountDistinct, ApproxQuantile
+
+    r = np.random.default_rng(13)
+    n = 512
+    table = ColumnarTable([
+        Column("v", DType.FRACTIONAL, values=r.normal(10, 3, n),
+               mask=r.random(n) > 0.05),
+        Column("g", DType.INTEGRAL,
+               values=r.integers(0, 30, n).astype(np.float64),
+               mask=np.ones(n, bool)),
+        Column("h", DType.INTEGRAL,
+               values=r.integers(0, 7, n).astype(np.float64),
+               mask=np.ones(n, bool)),
+    ])
+    if encoded:
+        assert table.encode(["g"])["g"].encoding is not None
+    analyzers = [
+        Mean("v"),                       # monoid
+        ApproxCountDistinct("g"),        # HLL sketch
+        ApproxQuantile("v", 0.5),        # KLL/selection
+        Uniqueness(("g",)),              # grouping (fusable)
+        Distinctness(("h",)),            # grouping (fusable)
+    ]
+    monkeypatch.setenv("DEEQU_TPU_PLAN_FUSION", "0")
+    base = AnalysisRunner.do_analysis_run(table, analyzers)
+    SCAN_STATS.reset()
+    monkeypatch.setenv("DEEQU_TPU_PLAN_FUSION", "1")
+    fused = AnalysisRunner.do_analysis_run(table, analyzers)
+    assert SCAN_STATS.fused_group_passes == 2
+    for a in analyzers:
+        m0, m1 = base.metric_map[a], fused.metric_map[a]
+        assert m0.value.is_success and m1.value.is_success, str(a)
+        assert _bits(m0.value.get()) == _bits(m1.value.get()), (
+            f"{a}: unfused={m0.value.get()!r} fused={m1.value.get()!r}"
+        )
+
+
+# -- the fusion fault rung ---------------------------------------------------
+
+
+def test_oom_mid_fused_group_demotes_bit_identical(
+    single_device, monkeypatch
+):
+    """A device OOM during the FUSED dispatch demotes the group: a
+    ``fusion_demote`` degradation is recorded and each member re-plans
+    UNFUSED from its own prepared keys — results stay bit-identical and
+    no fused pass is counted."""
+    from deequ_tpu.exceptions import DeviceOOMException
+
+    monkeypatch.setenv("DEEQU_TPU_HOST_GROUP_LIMIT", "1")
+    table = _grouping_table(seed=7)
+    requests = [GroupRequest(("a",)), GroupRequest(("b",))]
+    want = {
+        i: segment.group_counts_state(table, list(req.columns))
+        for i, req in enumerate(requests)
+    }
+
+    real = segment._device_bincount
+    keyspaces = set()
+
+    def oom_on_fused(keys, num_segments, mesh):
+        # the fused dispatch is the one whose keyspace exceeds every
+        # per-set keyspace (it is their sum)
+        if keyspaces and num_segments > max(keyspaces):
+            raise DeviceOOMException("injected mid-fused-group")
+        keyspaces.add(num_segments)
+        return real(keys, num_segments, mesh)
+
+    # learn the per-set keyspaces first (from the reference run above,
+    # via a dry prep), then arm the injector
+    for req in requests:
+        prep = segment._prepare_grouping(
+            table, list(req.columns), True, with_values=True
+        )
+        keyspaces.add(prep.keyspace)
+    monkeypatch.setattr(segment, "_device_bincount", oom_on_fused)
+
+    SCAN_STATS.reset()
+    got = fused_group_counts(table, requests)
+    demotes = [
+        d for d in SCAN_STATS.degradation_events
+        if d["kind"] == "fusion_demote"
+    ]
+    assert len(demotes) == 1
+    assert demotes[0]["passes"] == 2
+    assert "injected mid-fused-group" in demotes[0]["reason"]
+    assert SCAN_STATS.fused_group_passes == 0
+    assert sorted(got) == [0, 1], "demotion must still compute every set"
+    for i in got:
+        _assert_freq_state_identical(got[i], want[i], f"demoted set {i}")
+
+
+# -- cross-suite sub-plan sharing --------------------------------------------
+
+
+def test_subplan_sharing_across_permuted_suites(single_device):
+    """Two tenants submit the SAME analyzer set in different orders:
+    distinct exact plan keys, but one shared traced program below them —
+    the second suite builds nothing and the sub-plan hit is counted."""
+    SUBPLAN_CACHE.clear()
+    svc = VerificationService(max_batch=4, coalesce_window=0.0)
+    try:
+        r = np.random.default_rng(11)
+        n = 256
+        table = ColumnarTable([
+            Column("x", DType.FRACTIONAL, values=r.normal(0, 1, n),
+                   mask=np.ones(n, bool)),
+            Column("y", DType.FRACTIONAL, values=r.normal(5, 2, n),
+                   mask=np.ones(n, bool)),
+        ])
+        suite = [Completeness("x"), Mean("x"), Minimum("y")]
+        res_a = svc.submit(
+            table, required_analyzers=tuple(suite), tenant="a"
+        ).result(timeout=60)
+        built = SCAN_STATS.programs_built
+        assert built >= 1
+        assert SCAN_STATS.subplan_cache_hits == 0
+
+        res_b = svc.submit(
+            table, required_analyzers=tuple(reversed(suite)), tenant="b"
+        ).result(timeout=60)
+        assert SCAN_STATS.programs_built == built, (
+            "permuted suite must adopt the shared sub-plan, not re-trace"
+        )
+        assert SCAN_STATS.subplan_cache_hits >= 1
+        assert SCAN_STATS.programs_reused >= 1
+        for a in suite:
+            va = res_a.metrics[a].value
+            vb = res_b.metrics[a].value
+            assert va.is_success and vb.is_success, str(a)
+            assert _bits(va.get()) == _bits(vb.get()), str(a)
+    finally:
+        svc.stop(drain=False)
+
+
+def test_planner_obs_section_counts(single_device, monkeypatch):
+    """The obs ``planner`` registry section reads the optimizer census:
+    fused passes and sub-plan hits surface through execution_report."""
+    import deequ_tpu
+
+    monkeypatch.setenv("DEEQU_TPU_HOST_GROUP_LIMIT", "1")
+    table = _grouping_table(seed=5)
+    fused_group_counts(table, [GroupRequest(("a",)), GroupRequest(("b",))])
+    rep = deequ_tpu.execution_report()
+    assert rep["planner"]["fused_group_passes"] == 2
+    assert rep["planner"]["plan_fusion"] is True
+    assert "subplan_cache_hits" in rep["planner"]
+
+
+# -- the plan cost model -----------------------------------------------------
+
+
+def test_cost_model_monotone_in_every_feature(monkeypatch):
+    """The monotonicity contract: a wider or deeper plan NEVER predicts
+    cheaper — admission decisions keyed on a non-monotone predictor
+    would invert under load."""
+    monkeypatch.setenv("DEEQU_TPU_HIST_CPU_CAP", "64")
+    model = PlanCostModel(platform="cpu")
+    base = dict(rows=4096, scan_ops=2, sort_ops=1, select_ops=1,
+                hist_widths=(32,), group_keyspaces=(100,), tenants=2,
+                encoded_columns=1)
+    ramps = {
+        "rows": [0, 1, 100, 4096, 1 << 20],
+        "scan_ops": [0, 1, 5, 50],
+        "sort_ops": [0, 1, 4],
+        "select_ops": [0, 2, 8],
+        "hist_widths": [(), (16,), (64,), (65,), (1 << 12,),
+                        (1 << 12, 64), (1 << 12, 1 << 12)],
+        "group_keyspaces": [(), (10,), (1 << 14,), (1 << 14, 10)],
+        "tenants": [1, 2, 8],
+        "encoded_columns": [0, 1, 3],
+    }
+    for field, values in ramps.items():
+        prev = None
+        for v in values:
+            cost = model.predict(
+                PlanFeatures(**{**base, field: v})
+            ).total
+            if prev is not None:
+                assert cost >= prev, (field, v)
+            prev = cost
+
+
+def test_cost_cap_knobs_price_the_crossover(monkeypatch):
+    """DEEQU_TPU_HIST_CPU_CAP / DEEQU_TPU_HIST_ACCEL_CAP are cost-model
+    inputs: a width past the platform's cap prices strictly higher than
+    the same width under a raised cap."""
+    f = PlanFeatures(rows=1 << 16, hist_widths=(512,))
+    monkeypatch.setenv("DEEQU_TPU_HIST_CPU_CAP", "128")
+    capped = PlanCostModel(platform="cpu").predict(f).total
+    monkeypatch.setenv("DEEQU_TPU_HIST_CPU_CAP", "1024")
+    uncapped = PlanCostModel(platform="cpu").predict(f).total
+    assert capped > uncapped
+
+    monkeypatch.setenv("DEEQU_TPU_HIST_ACCEL_CAP", "128")
+    acapped = PlanCostModel(platform="tpu").predict(f).total
+    monkeypatch.setenv("DEEQU_TPU_HIST_ACCEL_CAP", "1024")
+    auncapped = PlanCostModel(platform="tpu").predict(f).total
+    assert acapped > auncapped
+
+
+def test_cap_knobs_typed_validation_and_snapshot():
+    """The cap knobs validate typed and appear in the consolidated env
+    registry snapshot."""
+    import os as _os
+
+    from deequ_tpu.envcfg import EnvConfigError, env_value, registry_snapshot
+
+    snap = registry_snapshot()
+    assert "DEEQU_TPU_HIST_CPU_CAP" in snap
+    assert "DEEQU_TPU_HIST_ACCEL_CAP" in snap
+    _os.environ["DEEQU_TPU_HIST_CPU_CAP"] = "banana"
+    try:
+        with pytest.raises(EnvConfigError):
+            env_value("DEEQU_TPU_HIST_CPU_CAP")
+        _os.environ["DEEQU_TPU_HIST_CPU_CAP"] = "0"
+        with pytest.raises(EnvConfigError):
+            env_value("DEEQU_TPU_HIST_CPU_CAP")
+    finally:
+        del _os.environ["DEEQU_TPU_HIST_CPU_CAP"]
+
+
+def test_estimate_suite_orders_heavier_suites_higher():
+    """The admission-time entry: a suite with a grouping analyzer on
+    top of the scalar set prices strictly higher, and more rows price
+    higher for the same suite."""
+    light = [Completeness("x")]
+    heavy = [Completeness("x"), Mean("x"), Uniqueness(("y",))]
+    n = 4096
+    cl = PLAN_COST_MODEL.estimate_suite(light, n).total
+    ch = PLAN_COST_MODEL.estimate_suite(heavy, n).total
+    assert ch > cl
+    assert PLAN_COST_MODEL.estimate_suite(heavy, 4 * n).total > ch
+
+
+# -- cost-priced admission ---------------------------------------------------
+
+
+def test_retry_after_orders_by_queued_cost():
+    """The tentpole admission observable: the SAME queue depth schedules
+    a LATER retry when the queued work is predicted heavier — depth
+    alone no longer decides retry_after_s."""
+    ctl = AdmissionController(max_pending=64)
+    # train the cost-drain rate: 4 suites of cost 1000 in 0.1s each
+    for _ in range(4):
+        ctl.note_served(1, 0.1, cost=1000.0)
+    light = ctl.retry_after(3, queued_cost=3 * 1000.0)
+    heavy = ctl.retry_after(3, queued_cost=3 * 50_000.0)
+    assert heavy > light, (
+        "same depth, heavier queued cost must schedule a later retry"
+    )
+    # without a trained cost rate the legacy depth path still answers
+    fresh = AdmissionController(max_pending=64)
+    assert fresh.retry_after(3, queued_cost=1e9) > 0
+
+
+def test_brownout_reads_cost_pressure():
+    """The brownout ladder escalates on queued-COST fraction even at a
+    shallow depth: K heavy suites brown out where K trivial ones
+    would not."""
+    b = BrownoutController(capacity=100)
+    lvl_depth_only = b.update(5)
+    b2 = BrownoutController(capacity=100)
+    lvl_cost = b2.update(5, cost_frac=0.95)
+    assert lvl_cost >= lvl_depth_only
+    assert lvl_cost >= 1, "95% queued-cost pressure must brown out"
+
+
+def test_service_stamps_predicted_cost_and_drains_ledger(single_device):
+    """submit() prices the suite through PLAN_COST_MODEL, the queue
+    ledger sums it, and a drained queue pins the ledger back to zero."""
+    svc = VerificationService(max_batch=4, coalesce_window=0.0)
+    try:
+        r = np.random.default_rng(2)
+        n = 512
+        table = ColumnarTable([
+            Column("x", DType.FRACTIONAL, values=r.normal(0, 1, n),
+                   mask=np.ones(n, bool)),
+        ])
+        fut = svc.submit(table, required_analyzers=(Completeness("x"),))
+        res = fut.result(timeout=60)
+        assert res.metrics[Completeness("x")].value.is_success
+        assert svc._queued_cost == 0.0
+        # the drain-rate feed saw the cost
+        assert svc._admission._avg_cost is not None
+        assert svc._admission._avg_cost > 0
+    finally:
+        svc.stop(drain=False)
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    sorted(glob.glob(os.path.join(FIXTURE_DIR, "*.json"))),
+    ids=lambda p: os.path.basename(p).replace(".json", ""),
+)
+def test_cost_priced_admission_under_load_seam(fixture):
+    """The chaos ``load``-seam corpus replays clean with cost-priced
+    admission live: exactly-once, no priority inversion, bit-identical
+    completions — the cost model changes WHEN callers retry, never
+    WHETHER accepted work resolves correctly."""
+    from deequ_tpu.resilience.chaos import ChaosSchedule, run_schedule
+
+    with open(fixture) as f:
+        schedule = ChaosSchedule.from_json(f.read())
+    report = run_schedule(schedule)
+    assert report.violations == [], report.violations
+    fl = report.fleet
+    assert fl["resolved_once"] == fl["accepted"]
+    assert fl["shed_by_class"].get("critical", 0) == 0
+
+
+# -- plan-fusion-refetch drift sims ------------------------------------------
+
+
+def test_fusion_refetch_lint_positive_and_negative(single_device):
+    """The drift sims: a fused plan whose traced program materializes
+    one output per sub-pass (the exact regression fusion exists to
+    prevent) is an ERROR; the real concatenated-counts program is
+    clean."""
+    import jax
+    import jax.numpy as jnp
+
+    from deequ_tpu.lint.plan_lint import lint_plan
+    from deequ_tpu.ops.scan_plan import plan_fused_grouping
+
+    plan_ir = plan_fused_grouping((40, 9), rows=512, hist_variant="scatter")
+    avals = (jax.ShapeDtypeStruct((512,), np.int64),)
+
+    def refetching(keys):  # two outputs: per-sub-pass fetches
+        a = jnp.bincount(jnp.clip(keys, 0, 39), length=40)
+        b = jnp.bincount(jnp.clip(keys, 0, 8), length=9)
+        return a, b
+
+    findings = lint_plan(plan_ir, refetching, avals)
+    rules = [f.rule for f in findings if f.severity == "error"]
+    assert "plan-fusion-refetch" in rules
+
+    def fused(keys):  # ONE concatenated counts vector
+        return jnp.bincount(jnp.clip(keys, 0, 48), length=49)
+
+    clean = lint_plan(plan_ir, fused, avals)
+    assert [f for f in clean if f.rule == "plan-fusion-refetch"] == []
+
+
+def test_subplan_key_identity_check():
+    """check_subplan_key: a complete key passes; a key missing any
+    identity component (layout, variant, ingest routing) is the
+    plan-fusion-refetch ERROR — suites with different layouts must not
+    share a traced program."""
+    from deequ_tpu.lint.plan_lint import check_subplan_key
+    from deequ_tpu.serve.plan_cache import SubPlanKey
+
+    good = SubPlanKey(
+        ops_sig=(("Completeness", "x"),), schema_sig=("x",),
+        layout_sig=("f64", 1), chunk=256, k_bucket=1, lut_sig=None,
+        variant="fused", hist_variant="scatter", ingest_variant="decoded",
+    )
+    assert check_subplan_key(good) == []
+
+    bad = SubPlanKey(
+        ops_sig=(("Completeness", "x"),), schema_sig=("x",),
+        layout_sig=None, chunk=256, k_bucket=1, lut_sig=None,
+        variant="fused", hist_variant=None, ingest_variant="decoded",
+    )
+    findings = check_subplan_key(bad)
+    assert len(findings) == 1
+    assert findings[0].rule == "plan-fusion-refetch"
+    assert findings[0].severity == "error"
+    assert "layout_sig" in findings[0].message
+    assert "hist_variant" in findings[0].message
+
+
+def test_fused_lint_memo_zero_traces_on_repeat(single_device, monkeypatch):
+    """Repeat fused dispatches of the same shape add ZERO lint traces —
+    the memo key carries the fusion signature, so fused and unfused
+    variants of the same sets lint separately without re-tracing."""
+    monkeypatch.setenv("DEEQU_TPU_HOST_GROUP_LIMIT", "1")
+    monkeypatch.setenv("DEEQU_TPU_PLAN_LINT", "error")
+    table = _grouping_table(seed=9, n=600)
+    requests = [GroupRequest(("a",)), GroupRequest(("b",))]
+    first = fused_group_counts(table, requests)
+    assert sorted(first) == [0, 1]
+    traces = SCAN_STATS.plan_lint_traces
+    assert traces >= 1, "armed lint must trace the fused program once"
+    again = fused_group_counts(table, requests)
+    assert sorted(again) == [0, 1]
+    assert SCAN_STATS.plan_lint_traces == traces, (
+        "repeat fused dispatch must memoize the lint verdict"
+    )
