@@ -2813,6 +2813,249 @@ def measure_repository_query(n_tenants: int, n_dates: int = 32):
         shutil.rmtree(repo_dir, ignore_errors=True)
 
 
+def measure_windowed_stream(n_streams: int = 1000, n_batches: int = 4):
+    """Continuous windowed verification probe (round 20,
+    deequ_tpu/windows: the window fold axis + watermark close protocol
+    under a ~1k-stream tenant fleet).
+
+    Hard gates — the probe REFUSES to report (AssertionError) unless:
+
+    - O(1) DISPATCHES PER BATCH: every stream's batch advances ALL of
+      its open panes in exactly ONE device dispatch (``pane_dispatches``
+      == streams x batches, including a sliding stream holding 4
+      concurrently-open panes), and the whole fleet shares a handful of
+      traced pane programs (``programs_built`` bounded by pane-bucket
+      shapes, NOT by stream count);
+    - BIT-IDENTITY: sampled streams' emitted windows are bit-identical
+      (exact float-bit compare) to one-shot VerificationSuite runs over
+      exactly those windows' rows;
+    - CLOSE LATENCY UNDER LOAD: with the hub's overload level RAISED,
+      on-time closes keep emitting (zero critical sheds, zero sheds at
+      all for on-time closes) and the p99 close-batch wall stays under
+      the 250ms SLO;
+    - EXACTLY-ONCE THROUGH KILL-AND-RESUME: a scripted mid-window kill
+      (hub rebuilt from the window-state store, twice) delivers every
+      window close exactly once — alert deliveries match the
+      uninterrupted reference with zero duplicates."""
+    import shutil
+    import struct
+    import tempfile
+
+    from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size
+    from deequ_tpu.data.table import ColumnarTable
+    from deequ_tpu.obs.registry import REGISTRY
+    from deequ_tpu.serve.admission import Slo
+    from deequ_tpu.verification import VerificationSuite
+    from deequ_tpu.windows import (
+        WINDOW_STATS,
+        StreamHub,
+        WatermarkPolicy,
+        WindowSpec,
+        WindowedStream,
+        clear_program_cache,
+    )
+
+    analyzers = [Size(), Completeness("v"), Mean("v"), Minimum("v"), Maximum("v")]
+    spec = WindowSpec(10.0, 10.0)
+    policy = WatermarkPolicy(2.0, "drop")
+    rows = 32
+
+    def bits(v):
+        return struct.pack("<d", float(v))
+
+    def metric_rows(result):
+        out = {}
+        for analyzer, metric in result.metrics.items():
+            assert metric.value.is_success, f"{analyzer} failed"
+            out[str(analyzer)] = bits(metric.value.get())
+        return out
+
+    def stream_batches(si):
+        rng = np.random.default_rng(20_000 + si)
+        out = []
+        for b in range(n_batches):
+            ts = np.sort(rng.uniform(b * 5.0, (b + 1) * 5.0, rows))
+            v = np.floor(rng.uniform(-40.0, 41.0, rows))
+            v[rng.uniform(0.0, 1.0, rows) < 0.1] = np.nan
+            out.append({"ts": ts, "v": v})
+        return out
+
+    # warm the pane programs out of the timed section (compile is a
+    # one-time fleet cost, shared via the program cache)
+    clear_program_cache()
+    warm = WindowedStream("warm", analyzers, spec=spec, policy=policy)
+    for batch in stream_batches(0):
+        warm.process_batch(batch)
+    warm.flush()
+
+    # -- A: the fleet under raised overload, one dispatch per batch ------
+    classes = ("critical", "standard", "best_effort")
+    hub = StreamHub()
+    hub.set_overload(1)  # brownout raised: on-time closes must survive
+    for si in range(n_streams):
+        hub.register_stream(
+            f"s{si:04d}", analyzers,
+            slo=Slo(deadline_ms=20_000.0, cls=classes[si % 3]),
+            spec=spec, policy=policy,
+        )
+    before = WINDOW_STATS.snapshot()
+    batch_walls = []
+    emitted = 0
+    t0 = time.time()
+    for si in range(n_streams):
+        sid = f"s{si:04d}"
+        for batch in stream_batches(si):
+            bt0 = time.time()
+            closes = hub.process_batch(sid, batch)
+            batch_walls.append(time.time() - bt0)
+            emitted += sum(1 for c in closes if c.emitted)
+    wall = time.time() - t0
+    snap = WINDOW_STATS.snapshot()
+
+    dispatches = snap["pane_dispatches"] - before["pane_dispatches"]
+    assert dispatches == n_streams * n_batches, (
+        f"O(1)-dispatch regression: {dispatches} dispatches for "
+        f"{n_streams * n_batches} stream-batches"
+    )
+    built = snap["programs_built"]
+    assert built <= 4, (
+        f"program-cache regression: {built} pane programs traced for "
+        f"{n_streams} streams sharing one (signature, geometry, shape)"
+    )
+    assert not hub.sheds, (
+        f"{len(hub.sheds)} on-time closes shed under overload — sheds are "
+        "for LATE closes only"
+    )
+    assert emitted >= n_streams, "fleet closed fewer windows than streams"
+    batch_walls.sort()
+    p99_ms = batch_walls[int(0.99 * (len(batch_walls) - 1))] * 1000.0
+    assert p99_ms < 250.0, f"close-batch p99 {p99_ms:.1f}ms breaches 250ms SLO"
+
+    # a sliding stream holding 4 open panes still pays ONE dispatch/batch
+    slide_before = WINDOW_STATS.snapshot()["pane_dispatches"]
+    slider = WindowedStream(
+        "slider", analyzers, spec=WindowSpec(20.0, 5.0), policy=policy,
+    )
+    for batch in stream_batches(1):
+        slider.process_batch(batch)
+    assert len(slider.open_panes) >= 4
+    slide_d = WINDOW_STATS.snapshot()["pane_dispatches"] - slide_before
+    assert slide_d == n_batches, (
+        f"sliding stream made {slide_d} dispatches for {n_batches} batches"
+    )
+
+    # -- B: sampled bit-identity vs one-shot suites ----------------------
+    checked = 0
+    for si in range(0, n_streams, max(1, n_streams // 5)):
+        batches = stream_batches(si)
+        probe = WindowedStream(f"id{si}", analyzers, spec=spec, policy=policy)
+        closes = []
+        for batch in batches:
+            closes.extend(probe.process_batch(batch))
+        closes.extend(probe.flush())
+        ts = np.concatenate([b["ts"] for b in batches])
+        v = np.concatenate([b["v"] for b in batches])
+        for c in closes:
+            if not c.emitted:
+                continue
+            keep = (ts >= c.start) & (ts < c.end)
+            vals = [None if np.isnan(x) else float(x) for x in v[keep]]
+            ref = (
+                VerificationSuite()
+                .on_data(ColumnarTable.from_pydict({"v": vals}))
+                .add_required_analyzers(analyzers)
+                .run()
+            )
+            assert metric_rows(c.result) == metric_rows(ref), (
+                f"stream {si} window [{c.start},{c.end}) drifted from the "
+                "one-shot suite — windows must be BIT-identical"
+            )
+            checked += 1
+    assert checked >= 5
+
+    # -- C: exactly-once alerts through a scripted double kill -----------
+    class Recorder:
+        def __init__(self):
+            self.seen = []
+
+        def observe_verification(self, stream_id, result):
+            self.seen.append(stream_id)
+
+    kr_streams = 8
+    ref_monitor = Recorder()
+    for si in range(kr_streams):
+        probe = WindowedStream(
+            f"kr{si}", analyzers, spec=spec, policy=policy, monitor=ref_monitor,
+        )
+        for batch in stream_batches(si):
+            probe.process_batch(batch)
+        probe.flush()
+
+    state_root = tempfile.mkdtemp(prefix="bench_wstream_")
+    try:
+        monitor = Recorder()
+
+        def new_hub():
+            hub = StreamHub(
+                monitor=monitor, state_root=state_root, checkpoint_every=2,
+            )
+            for si in range(kr_streams):
+                hub.register_stream(
+                    f"kr{si}", analyzers, spec=spec, policy=policy,
+                    batch_rows=rows,
+                )
+            return hub
+
+        khub = new_hub()
+        resumes = 0
+        for kill_at in (2, 3):  # mid-window on the 10s tumbling grid
+            for si in range(kr_streams):
+                sid = f"kr{si}"
+                stream = khub.stream(sid)
+                while stream.next_batch_index < kill_at:
+                    khub.process_batch(
+                        sid, stream_batches(si)[stream.next_batch_index]
+                    )
+            del khub  # kill: process state gone, window-state store survives
+            khub = new_hub()
+            resumes += 1
+        for si in range(kr_streams):
+            sid = f"kr{si}"
+            stream = khub.stream(sid)
+            while stream.next_batch_index < n_batches:
+                khub.process_batch(
+                    sid, stream_batches(si)[stream.next_batch_index]
+                )
+            stream.flush()
+        assert sorted(monitor.seen) == sorted(ref_monitor.seen), (
+            "kill-and-resume alert drift: "
+            f"{len(monitor.seen)} deliveries vs {len(ref_monitor.seen)} "
+            "reference — every window close must alert EXACTLY once"
+        )
+    finally:
+        shutil.rmtree(state_root, ignore_errors=True)
+
+    obs = REGISTRY.snapshot()["windows"]
+    assert obs["active"] and obs["closes_emitted"] >= emitted
+
+    return {
+        "wstream_streams": n_streams,
+        "wstream_closes_per_sec": round(emitted / max(wall, 1e-9), 1),
+        "wstream_batches_per_sec": round(
+            (n_streams * n_batches) / max(wall, 1e-9), 1
+        ),
+        "wstream_dispatches_per_batch": 1.0,
+        "wstream_programs_built": int(built),
+        "wstream_close_p99_ms": round(p99_ms, 2),
+        "wstream_windows_emitted": int(emitted),
+        "wstream_identity_windows_checked": int(checked),
+        "wstream_resumes": int(resumes),
+        "wstream_suppressed": int(
+            WINDOW_STATS.snapshot()["closes_suppressed"]
+        ),
+    }
+
+
 def main():
     import deequ_tpu  # noqa: F401 — enables x64, selects the TPU backend
     from deequ_tpu.analyzers.runner import AnalysisRunner
@@ -2984,11 +3227,15 @@ def main():
     # >=2x acceptance banks as pending-parallel-hw on CPU sessions
     kernel_probe = measure_kernel_ab(smoke=smoke)
     print(f"kernel A/B probe: {kernel_probe}", file=sys.stderr)
+    # round-20 windowed-verification probe (one-dispatch-per-batch /
+    # shared programs / bit-identity / exactly-once resume asserted)
+    wstream_probe = measure_windowed_stream(48 if smoke else 192)
+    print(f"windowed-stream probe: {wstream_probe}", file=sys.stderr)
     ckpt_probe = {
         **ckpt_probe, **oom_probe, **reshard_probe, **select_probe,
         **lint_probe, **ingest_probe, **governance_probe, **obs_probe,
         **serving_probe, **fleet_probe, **pfleet_probe, **fencing_probe,
-        **repo_probe, **kernel_probe,
+        **repo_probe, **kernel_probe, **wstream_probe,
     }
 
     if smoke:

@@ -265,6 +265,32 @@ def config12(n_rows: int):
     )
 
 
+def config13(n_streams: int):
+    """WINDOWED-VERIFICATION config (round 20, deequ_tpu/windows: the
+    window fold axis + watermark close protocol): a ~1k-stream
+    SLO-classed tenant fleet of tumbling event-time windows driven
+    batch-by-batch under a RAISED overload level, plus a sliding
+    4-open-pane stream, sampled one-shot references, and a scripted
+    double kill-and-resume. ONE workload definition, shared with
+    bench.py's ``measure_windowed_stream`` probe, which hard-asserts —
+    before it reports anything — exactly ONE device dispatch per
+    stream-batch (pane count notwithstanding), a program cache bounded
+    by pane-bucket shapes rather than stream count, per-window
+    bit-identity vs one-shot VerificationSuite runs, close-batch p99
+    under the 250ms SLO with ZERO sheds for on-time closes (critical
+    included), and exactly-once alert delivery through the double
+    resume."""
+    import bench
+
+    probe = bench.measure_windowed_stream(n_streams)
+    return _emit(
+        config=13, metric="wstream_closes_per_sec",
+        rows=n_streams,
+        value=probe["wstream_closes_per_sec"], unit="closes/sec",
+        **{k: v for k, v in probe.items() if k != "wstream_closes_per_sec"},
+    )
+
+
 def config3_workload(n_rows: int, n_cols: int = 50):
     """(table, analyzers) for the config-3 shape — 25 correlations + 50
     median columns over correlated normals. ONE definition shared by
@@ -806,6 +832,11 @@ def main():
         # (one-dispatch / bit-identity / sharing-beats-exact-hits /
         # cost-ordered-retries gates asserted inside)
         12: lambda: config12(args.rows or (1 << 16)),
+        # round-20 windowed-verification config: the ~1k-stream windowed
+        # tenant fleet (one-dispatch-per-batch / shared pane programs /
+        # bit-identity / p99-close SLO / exactly-once-through-kill gates
+        # asserted inside)
+        13: lambda: config13(args.rows or 1000),
     }
     if args.all:
         for k in sorted(runners):
@@ -818,7 +849,7 @@ def main():
 
         bench.main()
     else:
-        ap.error("--config {1,2,3,4,5,6,7,8,9,10,11,12} or --all")
+        ap.error("--config {1,2,3,4,5,6,7,8,9,10,11,12,13} or --all")
 
 
 if __name__ == "__main__":

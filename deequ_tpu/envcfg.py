@@ -368,3 +368,29 @@ TRACE_CAPACITY = register(EnvVar(
     "DEEQU_TPU_TRACE_CAPACITY", "int", default=None, minimum=1,
     doc="ring-buffer capacity (records) of the env-armed flight recorder",
 ))
+WINDOW_SIZE_S = register(EnvVar(
+    "DEEQU_TPU_WINDOW_SIZE_S", "float", default=60.0, minimum=1e-6,
+    doc="default event-time window size, in seconds, for windowed "
+        "verification streams (deequ_tpu/windows) that do not pass an "
+        "explicit WindowSpec",
+))
+WINDOW_SLIDE_S = register(EnvVar(
+    "DEEQU_TPU_WINDOW_SLIDE_S", "float", default=None, minimum=1e-6,
+    doc="default window slide, in seconds, for windowed verification "
+        "streams (unset = tumbling: slide == size); must not exceed the "
+        "window size",
+))
+WATERMARK_LAG_S = register(EnvVar(
+    "DEEQU_TPU_WATERMARK_LAG_S", "float", default=5.0, minimum=0.0,
+    doc="bounded-disorder allowance, in seconds: the per-stream "
+        "watermark trails the max observed event time by this lag; rows "
+        "older than the watermark are LATE and route by the late policy",
+))
+LATE_POLICY = register(EnvVar(
+    "DEEQU_TPU_LATE_POLICY", "choice", default="drop",
+    choices=("drop", "side_output", "refuse"),
+    doc="routing for rows behind the watermark: 'drop' counts them "
+        "(ScanStats.late_rows), 'side_output' quarantines their "
+        "batch-aligned row ranges on the partial-result surface, "
+        "'refuse' raises typed LateDataException",
+))

@@ -182,6 +182,28 @@ class DeadlineExceededException(ServiceOverloadedException):
         self.waited_s = waited_s
 
 
+class LateDataException(MetricCalculationRuntimeException):
+    """A windowed stream (deequ_tpu/windows) received rows whose event
+    time is older than the stream's watermark under the ``refuse`` late
+    policy: the caller asked for an error instead of silent exclusion.
+    Under ``drop`` the rows are counted (``ScanStats.late_rows``); under
+    ``side_output`` their batch-aligned row ranges are quarantined on the
+    partial-result surface — this exception is the third, strictest
+    routing. ``late_rows`` is how many rows in the offending batch were
+    late; ``watermark`` the fence they fell behind; ``oldest_event_time``
+    the worst offender's event time."""
+
+    def __init__(self, message: str, stream: Optional[str] = None,
+                 late_rows: Optional[int] = None,
+                 watermark: Optional[float] = None,
+                 oldest_event_time: Optional[float] = None):
+        super().__init__(message)
+        self.stream = stream
+        self.late_rows = late_rows
+        self.watermark = watermark
+        self.oldest_event_time = oldest_event_time
+
+
 class StaleEpochException(ServeException):
     """A fenced-out coordinator (serve/lease.py) tried to act: its lease
     epoch is older than the highest epoch the cluster has observed — a

@@ -59,6 +59,20 @@ Rules (ids are stable; severities per ``findings.LintFinding``):
   callback (an in-program decode round trip the fused-gather contract
   forbids; re-asserted here per encoded program on top of
   ``plan-host-callback`` so the encoded rule is self-contained).
+- ``plan-window-refeed`` (error) — a WINDOWED plan
+  (``variant="windowed"``, the round-20 continuous-verification pane
+  fold, deequ_tpu/windows) whose declared window geometry
+  (``ScanPlan.window_spec`` / ``watermark_policy``), pane-bucket count
+  (``tenants``) or pane fold tags are inconsistent, or whose traced
+  pane program contains a host-boundary primitive. The pane fold
+  advances W concurrently-open panes in ONE dispatch per batch and
+  merges per-pane scalars host-side by monoid tag; a malformed
+  geometry re-derives DIFFERENT pane starts on resume (the same row
+  re-fed into a different pane set — silent cross-window corruption),
+  a non-elementwise tag has no pane merge at all, and a callback in
+  the pane program re-feeds rows through the host per batch. Also
+  fires on a NON-windowed plan that declares window geometry (planner
+  drift in the other direction).
 - ``plan-fusion-refetch`` (error) — a FUSED multi-pass plan
   (``ScanPlan.fusion`` non-empty, the round-19 cross-pass grouping
   fusion) whose traced program produces more than one output (each
@@ -208,6 +222,12 @@ def _check_fold_tags(plan_ir) -> List[LintFinding]:
 
     findings: List[LintFinding] = []
     declared = plan_ir.fold_tags
+    if getattr(plan_ir, "variant", None) == "windowed":
+        # windowed plans declare the pane fold on an ops=() contract
+        # plan (ops/scan_plan.plan_windowed_scan) — there are no
+        # resolved ops to compare against; their declared tags are
+        # checked by plan-window-refeed against the pane-merge monoids
+        return findings
     if len(declared) != len(plan_ir.ops):
         findings.append(
             LintFinding(
@@ -352,6 +372,155 @@ def _check_encoded_ingest(plan_ir, census: Optional[Counter]) -> List[LintFindin
     return findings
 
 
+def _check_windowed(plan_ir, census: Optional[Counter]) -> List[LintFinding]:
+    """The ``plan-window-refeed`` rule: a windowed plan's declared pane
+    geometry and fold tags must be internally consistent (same-geometry
+    resume re-derives the SAME pane starts; every leaf has a pane
+    merge), and the traced pane program must be host-callback-free —
+    the fold advances every open pane in one dispatch, so a callback
+    re-feeds rows through the host per batch."""
+    import math
+
+    from deequ_tpu.ops.scan_plan import KNOWN_FOLD_TAGS
+
+    findings: List[LintFinding] = []
+    spec = getattr(plan_ir, "window_spec", None)
+    policy = getattr(plan_ir, "watermark_policy", None)
+    if getattr(plan_ir, "variant", None) != "windowed":
+        if spec is not None or policy is not None:
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"non-windowed plan (variant={plan_ir.variant!r}) "
+                    f"declares window geometry (window_spec={spec!r}, "
+                    f"watermark_policy={policy!r}): the executor would "
+                    "route it past the pane fold while the plan claims "
+                    "windowed semantics — planner drift",
+                )
+            )
+        return findings
+    panes = int(getattr(plan_ir, "tenants", 0) or 0)
+    if panes < 1:
+        findings.append(
+            LintFinding(
+                "plan-window-refeed",
+                "error",
+                f"windowed plan declares pane-bucket count {panes}: a "
+                "pane fold needs at least one concurrently-open pane "
+                "slot (ScanPlan.tenants doubles as the bucket width)",
+            )
+        )
+    if not (isinstance(spec, tuple) and len(spec) == 3):
+        findings.append(
+            LintFinding(
+                "plan-window-refeed",
+                "error",
+                f"windowed plan declares malformed window_spec {spec!r}: "
+                "expected the (size_s, slide_s, time_column) signature "
+                "of windows/spec.WindowSpec",
+            )
+        )
+    else:
+        size_s, slide_s = float(spec[0]), float(spec[1])
+        if not (
+            math.isfinite(size_s)
+            and math.isfinite(slide_s)
+            and 0.0 < slide_s <= size_s
+        ):
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"windowed plan declares window geometry size_s="
+                    f"{size_s!r} slide_s={slide_s!r}: pane starts are "
+                    "re-derived from this geometry on every batch AND on "
+                    "resume, so it must satisfy 0 < slide <= size (finite) "
+                    "or the same row re-feeds into a different pane set",
+                )
+            )
+    if not (isinstance(policy, tuple) and len(policy) == 2):
+        findings.append(
+            LintFinding(
+                "plan-window-refeed",
+                "error",
+                f"windowed plan declares malformed watermark_policy "
+                f"{policy!r}: expected the (lag_s, late_policy) signature "
+                "of windows/spec.WatermarkPolicy",
+            )
+        )
+    else:
+        from deequ_tpu.windows.spec import LATE_POLICIES
+
+        lag_s, late_policy = policy
+        if not (math.isfinite(float(lag_s)) and float(lag_s) >= 0.0):
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"windowed plan declares watermark lag {lag_s!r}: the "
+                    "close fence must advance monotonically, which needs "
+                    "a finite non-negative lag",
+                )
+            )
+        if late_policy not in LATE_POLICIES:
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"windowed plan declares late policy {late_policy!r} "
+                    f"(known: {LATE_POLICIES}): late rows would route "
+                    "through no typed path at all",
+                )
+            )
+    for tags in plan_ir.fold_tags:
+        bad = [t for t in tags if t not in KNOWN_FOLD_TAGS]
+        if bad:
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"windowed plan declares unknown pane fold tag(s) "
+                    f"{bad} (known: {sorted(KNOWN_FOLD_TAGS)})",
+                )
+            )
+        nonelem = [
+            t for t in tags if t in KNOWN_FOLD_TAGS and t not in _MERGE_PROBES
+        ]
+        if nonelem:
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"windowed plan declares non-elementwise pane fold "
+                    f"tag(s) {nonelem}: the pane fold merges per-pane "
+                    "scalars by elementwise monoid "
+                    f"({sorted(_MERGE_PROBES)}); a gather-class leaf has "
+                    "no pane merge and would silently drop state at the "
+                    "checkpoint boundary",
+                )
+            )
+    if census is not None:
+        callbacks = {
+            p: census[p] for p in _CALLBACK_PRIMITIVES if census.get(p)
+        }
+        if callbacks:
+            findings.append(
+                LintFinding(
+                    "plan-window-refeed",
+                    "error",
+                    f"windowed pane program contains host-boundary "
+                    f"primitive(s) {callbacks}: the pane fold advances "
+                    "every open pane in ONE transfer-free dispatch per "
+                    "batch — a callback re-feeds rows through the host "
+                    "per batch (re-asserted here per windowed program on "
+                    "top of plan-host-callback so the windowed rule is "
+                    "self-contained)",
+                )
+            )
+    return findings
+
+
 def _check_packed_members(plan_ir, census: Optional[Counter]) -> List[LintFinding]:
     """Per-tenant-slice contract checks for a PACKED multi-tenant plan
     (``ScanPlan.tenants > 0``, deequ_tpu/serve): every member shares ONE
@@ -446,12 +615,14 @@ def lint_plan(
         # layout-only encoded checks still run without a traced program
         findings += _check_encoded_ingest(plan_ir, None)
         findings += _check_packed_members(plan_ir, None)
+        findings += _check_windowed(plan_ir, None)
 
     if trace_fn is not None:
         closed = jax.make_jaxpr(trace_fn)(*avals)
         census = primitive_census(closed)
         findings += _check_encoded_ingest(plan_ir, census)
         findings += _check_packed_members(plan_ir, census)
+        findings += _check_windowed(plan_ir, census)
         sorts = sum(census.get(p, 0) for p in _SORT_PRIMITIVES)
         if plan_ir.variant == "select" and sorts:
             findings.append(

@@ -121,18 +121,25 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # atomic seams as resilience/ (a swallowed CorruptStateException
     # would silently double promotion events), and its typed lifecycle /
     # shed handling must never degrade to untyped raises.
+    # Round 20 adds windows/ to host-fetch, bare-except, typed-raise and
+    # durable-write: the pane-fold engine fetches per-pane leaves from
+    # the device every batch (accounting applies in full), late-data
+    # routing and window sheds MUST stay typed (an untyped raise where
+    # LateDataException belongs silently changes a stream's policy), and
+    # the window-state store persists the exactly-once close fence on
+    # the same atomic seams the crashpoint matrix exercises.
     "host-fetch": (
         "ops/", "parallel/", "anomaly/", "serve/", "obs/", "repository/",
-        "profiles/", "suggestions/", "control/",
+        "profiles/", "suggestions/", "control/", "windows/",
     ),
     "bare-except": (
         "ops/", "parallel/", "resilience/", "serve/", "obs/", "repository/",
-        "profiles/", "suggestions/", "control/",
+        "profiles/", "suggestions/", "control/", "windows/",
     ),
     "jit-impure": ("",),
     "typed-raise": (
         "ops/", "resilience/", "serve/", "obs/", "repository/",
-        "profiles/", "suggestions/", "control/",
+        "profiles/", "suggestions/", "control/", "windows/",
     ),
     "span-in-jit": ("",),
     # PR 18: every module that persists durable state (the fleet ledger
@@ -140,7 +147,9 @@ RULE_SCOPES: Dict[str, Tuple[str, ...]] = {
     # checkpoint/chaos/atomic code itself) must write through the shared
     # atomic temp+fsync+rename helper — a hand-rolled open("wb") there
     # is a torn-write hazard the crashpoint matrix cannot vouch for.
-    "durable-write": ("serve/", "repository/", "control/", "resilience/"),
+    "durable-write": (
+        "serve/", "repository/", "control/", "resilience/", "windows/",
+    ),
     "suppress-reason": ("",),
 }
 

@@ -488,6 +488,23 @@ def _control_section() -> dict:
     return out
 
 
+def _windows_section() -> dict:
+    """Read-through over the continuous windowed-verification engine
+    (round 20, deequ_tpu/windows): panes opened/closed, closes
+    emitted/suppressed/shed, late rows per policy, resumes, and
+    state-save failures. Guarded on ``sys.modules`` like the control
+    section — a process that never opened a stream reports
+    ``active: False``, not phantom zeros."""
+    import sys
+
+    out: Dict[str, Any] = {"active": False}
+    windows = sys.modules.get("deequ_tpu.windows.engine")
+    if windows is not None:
+        out["active"] = True
+        out.update(windows.WINDOW_STATS.snapshot())
+    return out
+
+
 REGISTRY.register_collector("scan", _scan_section)
 REGISTRY.register_collector("retry", _retry_section)
 REGISTRY.register_collector("hbm", _hbm_section)
@@ -496,6 +513,7 @@ REGISTRY.register_collector("repository", _repository_section)
 REGISTRY.register_collector("kernels", _kernels_section)
 REGISTRY.register_collector("planner", _planner_section)
 REGISTRY.register_collector("control", _control_section)
+REGISTRY.register_collector("windows", _windows_section)
 
 
 # -- the serving layer's owned instruments (always-on: one histogram

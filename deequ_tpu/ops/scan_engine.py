@@ -382,6 +382,12 @@ class ScanStats:
         # exact PlanKey). Read through the obs "planner" section.
         self.fused_group_passes = 0
         self.subplan_cache_hits = 0
+        # windowed verification (deequ_tpu/windows, round 20): rows that
+        # arrived behind their stream's watermark and were routed by the
+        # typed late policy ('drop' counts here; 'side_output'
+        # additionally quarantines the batch range via
+        # record_unverified; 'refuse' raises LateDataException instead)
+        self.late_rows = 0
 
     @property
     def ingest_overlap_frac(self) -> float:
@@ -443,6 +449,13 @@ class ScanStats:
         with self._fetch_lock:
             self.device_fetches += 1
             self.bytes_fetched += int(nbytes)
+
+    def record_late_rows(self, n: int) -> None:
+        """Account ``n`` stream rows that fell behind their watermark
+        (deequ_tpu/windows late routing). Written from stream-hub worker
+        threads, so the read-modify-write shares the fetch lock."""
+        with self._fetch_lock:
+            self.late_rows += int(n)
 
     def record_hist_dispatch(self, variant: str, n: int = 1) -> None:
         """Account ``n`` histogram/segment-fold kernel dispatches under

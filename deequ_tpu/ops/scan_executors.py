@@ -411,6 +411,20 @@ def run_packed(requests, tenants=None):
     return run_coalesced(requests, tenants=tenants)
 
 
+def run_windowed_scan(stream, batches, flush=False):
+    """The windowed executor (round 20): advance a
+    ``deequ_tpu.windows.WindowedStream`` over ``batches`` — every open
+    event-time pane folds in ONE dispatch per batch (the
+    ``variant="windowed"`` plan's contract), a resumed stream skips the
+    batches its recovered state already folded, and the return value is
+    the list of WindowClose records the advancing watermark produced
+    (the windows engine owns the pane program; this is the policy-driver
+    entry so the executor registry covers the windowed strategy)."""
+    from deequ_tpu.windows.engine import drive
+
+    return drive(stream, batches, flush=flush)
+
+
 #: executor registry — ``classify()``'s kinds to their run strategies.
 #: "resident" and "sharded" intentionally share the ladder body (the
 #: mesh rungs self-gate on mesh size).
@@ -419,4 +433,5 @@ EXECUTORS = {
     "resident": run_laddered_scan,
     "sharded": run_laddered_scan,
     "packed": run_packed,
+    "windowed": run_windowed_scan,
 }

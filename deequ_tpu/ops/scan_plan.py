@@ -173,6 +173,21 @@ class ScanPlan:
     #: ``plan-fusion-refetch`` lint rule. Also a lint-memo-key component
     #: so fused and unfused variants of the same op set lint separately.
     fusion: Tuple[int, ...] = ()
+    #: WINDOWED plan (deequ_tpu/windows, round 20): the declared window
+    #: geometry ``(size_s, slide_s, time_column)`` of a
+    #: ``variant="windowed"`` plan, whose program advances every open
+    #: pane in ONE dispatch per batch (the window fold axis). ``tenants``
+    #: doubles as the pane-bucket count for such plans. None = not a
+    #: windowed plan. The ``plan-window-refeed`` lint rule checks the
+    #: declared geometry, the pane-count/fold-tag consistency, and that
+    #: the traced pane fold smuggles no host callbacks; the window
+    #: signature is also a lint-memo-key component.
+    window_spec: Optional[Tuple] = None
+    #: the declared watermark policy ``(lag_s, late_policy)`` riding a
+    #: windowed plan (None otherwise) — late routing is part of the
+    #: plan's contract: a windowed program with no declared policy would
+    #: silently fold late rows into closed panes
+    watermark_policy: Optional[Tuple] = None
 
 
 @dataclass(frozen=True)
@@ -270,6 +285,61 @@ def plan_fused_grouping(
         hist_variant=hist_variant,
         fetch_contract="one-fetch",
         fusion=widths,
+    )
+
+
+def plan_windowed_scan(
+    fold_tags: Sequence[str],
+    panes: int,
+    window_spec: Tuple,
+    watermark_policy: Tuple,
+) -> ScanPlan:
+    """Resolve the WINDOWED plan (round 20): sliding/tumbling event-time
+    windows as an extra fold dimension of the device program. Like the
+    fused-grouping plan, it carries no ScanOps — the program is the pane
+    step the windows engine builds — but it declares the contracts the
+    ``plan-window-refeed`` lint rule checks: the window geometry
+    ``(size_s, slide_s, time_column)``, the watermark policy
+    ``(lag_s, late_policy)``, the pane-bucket count (``tenants``), the
+    per-pane fold tags (every leaf a KNOWN_FOLD_TAGS monoid, so
+    per-window metrics stay bit-identical to a one-shot run), and the
+    one-fetch contract (ONE (panes, leaves) materialization per batch,
+    no host callbacks inside the pane fold)."""
+    tags = tuple(str(t) for t in fold_tags)
+    if not tags:
+        raise ValueError("a windowed plan needs at least one fold leaf")
+    unknown = sorted(set(tags) - KNOWN_FOLD_TAGS)
+    if unknown:
+        raise ValueError(
+            f"windowed plan declares unknown fold tags {unknown!r}; "
+            f"known: {sorted(KNOWN_FOLD_TAGS)}"
+        )
+    if int(panes) < 1:
+        raise ValueError(f"a windowed plan needs >= 1 pane, got {panes!r}")
+    spec = tuple(window_spec)
+    if len(spec) != 3:
+        raise ValueError(
+            f"window_spec must be (size_s, slide_s, time_column), got {spec!r}"
+        )
+    size_s, slide_s = float(spec[0]), float(spec[1])
+    if not (size_s > 0.0 and slide_s > 0.0 and slide_s <= size_s):
+        raise ValueError(
+            f"window_spec needs 0 < slide_s <= size_s, got {spec!r}"
+        )
+    policy = tuple(watermark_policy)
+    if len(policy) != 2:
+        raise ValueError(
+            f"watermark_policy must be (lag_s, late_policy), got {policy!r}"
+        )
+    return ScanPlan(
+        ops=(),
+        resident=False,
+        variant="windowed",
+        fold_tags=(tags,),
+        fetch_contract="one-fetch",
+        tenants=int(panes),
+        window_spec=spec,
+        watermark_policy=policy,
     )
 
 

@@ -45,7 +45,22 @@ existing injector seam into one timeline —
   submission carrying a real SLO class (t0 critical, t1/t2 standard,
   t3 best_effort). No worker dies: the seam fuzzes admission control,
   the deadline-aware fair queue, and the brownout ladder, not
-  failover —
+  failover;
+- ``window`` — continuous-verification faults (round 20, the windowed
+  streaming tier, deequ_tpu/windows): scripted LATE BURSTS (a slab of
+  a batch's rows rewound behind the stream's watermark — the typed
+  late-routing seam), DISORDER SPIKES (event-time jitter inside a
+  batch), KILLS mid-window (the stream objects are dropped and
+  resumed from the checksummed window-state store, replaying the
+  checkpoint interval), RESUME REPLAYS (a DOUBLE kill-and-resume —
+  the same closes replay twice through the exactly-once fence) and
+  OVERLOAD spikes (the hub's brownout level rises, demoting late
+  closes of non-critical streams to typed ``window_shed`` records).
+  A schedule with any window event runs the STREAM scenario: three
+  SLO-classed windowed streams (critical / standard / best_effort)
+  folding seeded event-time batches, checked against a fault-free
+  windowed reference over the SAME (late-burst/disorder-modified)
+  batch timeline —
 
 run one governed verification under it (``on_batch_error="skip"``,
 ``on_device_error="fallback"``, a `RunPolicy` budget), and then check the
@@ -82,12 +97,22 @@ system's OWN cross-cutting invariants as oracles:
    shed while a same-plan ``best_effort`` request DISPATCHED on the
    same worker: a best_effort that resolved successfully before a
    co-queued critical's shed popped while that critical still waited,
-   which the class-tiered queue's strict priority forbids.
+   which the class-tiered queue's strict priority forbids;
+11. exactly-once window closes (window seam) — every window the
+   fault-free reference closes is, in the chaos run, emitted EXACTLY
+   once (bit-identical metrics, kills/replays included) or shed TYPED
+   (non-critical streams, only under a scripted overload spike);
+   nothing emits twice through any number of kill-and-resume cycles,
+   the critical stream's close set never shrinks, watermarks never
+   regress, and a scripted late burst shows up in the typed late
+   ledgers (dropped counts / quarantined side-output ranges), never
+   in a closed window's rows.
 
 Worker-seam schedules check oracles 1/2/3/5/8 (the streaming-specific
 row-accounting and fetch/ledger oracles have no fleet analogue — a
 tenant's suite either completes bit-identically after failover or
-rejects typed); load-seam schedules check 1/2/3/9/10.
+rejects typed); load-seam schedules check 1/2/3/9/10; window-seam
+schedules check 1/2 plus oracle 11.
 
 A failing schedule is reduced by :func:`shrink_schedule` — classic
 delta debugging (ddmin) over the event list, re-running the oracles per
@@ -135,7 +160,7 @@ HANG_SECONDS = 0.6
 TERMINATION_SLACK = 2.0
 
 _SCAN_KINDS = ("oom", "compile", "lost", "hang")
-_SEAMS = ("scan", "batch", "staging", "fs", "worker", "load")
+_SEAMS = ("scan", "batch", "staging", "fs", "worker", "load", "window")
 
 #: fleet scenario geometry (worker seam): the scenario table splits into
 #: one slice per tenant, each submitted once per wave; worker events
@@ -188,6 +213,28 @@ LOAD_SPIKE_DEADLINE_MS = 500.0
 #: per-worker queue bound for the load scenario: small enough that a
 #: scripted burst reaches admission pressure (class budgets, brownout)
 LOAD_MAX_PENDING = 24
+
+#: window-seam (round 20) scenario geometry: three SLO-classed windowed
+#: streams over seeded event-time batches — tumbling 10s windows,
+#: watermark lag 2s, batches spanning 5s of event time each. The
+#: best_effort deadline is tight enough that ordinary close lateness
+#: (up to ~one batch span + lag) sheds it under a scripted overload
+#: spike; standard sheds only on the latest closes; critical never
+#: sheds by class. The standard stream runs the side_output late
+#: policy so a late burst exercises the quarantine route too.
+WINDOW_N_BATCHES = 12
+WINDOW_BATCH_ROWS = 24
+WINDOW_BATCH_SPAN_S = 5.0
+WINDOW_SIZE_S = 10.0
+WINDOW_LAG_S = 2.0
+WINDOW_STREAM_SLO = (
+    ("w_crit", "critical", 20_000.0, "drop"),
+    ("w_std", "standard", 4_000.0, "side_output"),
+    ("w_be", "best_effort", 400.0, "drop"),
+)
+_WINDOW_KINDS = (
+    "late_burst", "disorder_spike", "kill", "resume_replay", "overload",
+)
 
 
 def _fast_retry():
@@ -479,6 +526,67 @@ class ChaosSchedule:
             seed=seed, events=tuple(events), run_deadline=30.0,
         )
 
+    @staticmethod
+    def generate_window(seed: int) -> "ChaosSchedule":
+        """Seeded WINDOW-seam schedule (round 20, the continuous
+        windowed-verification tier): scripted late bursts, disorder
+        spikes, mid-window kills (resume from the window-state store),
+        resume replays (a DOUBLE kill — the same closes replay twice
+        through the exactly-once fence) and overload spikes over the
+        three-stream scenario. Data events (late_burst/disorder) draw
+        batches >= 2 so the stream's watermark has actually advanced —
+        a burst into a fresh stream is not late at all. At most one
+        overload spike per schedule (the shed oracle wants an
+        unambiguous window of legitimacy)."""
+        rng = Random(seed)
+        events: List[dict] = []
+        used_overload = False
+        for _ in range(1 + rng.randrange(3)):
+            kind = rng.choice(
+                ("late_burst", "late_burst", "disorder_spike", "kill",
+                 "kill", "resume_replay", "overload")
+            )
+            if kind == "late_burst":
+                events.append({
+                    "seam": "window", "kind": "late_burst",
+                    "batch": 2 + rng.randrange(WINDOW_N_BATCHES - 2),
+                    "stream": rng.choice(
+                        [s for s, _c, _d, _p in WINDOW_STREAM_SLO]
+                    ),
+                    "rows": 4 + rng.randrange(8),
+                    "rewind_s": round(12.0 + rng.random() * 10.0, 3),
+                })
+            elif kind == "disorder_spike":
+                events.append({
+                    "seam": "window", "kind": "disorder_spike",
+                    "batch": 2 + rng.randrange(WINDOW_N_BATCHES - 2),
+                    "stream": rng.choice(
+                        [s for s, _c, _d, _p in WINDOW_STREAM_SLO]
+                    ),
+                    "jitter_s": round(1.0 + rng.random() * 4.0, 3),
+                })
+            elif kind in ("kill", "resume_replay"):
+                events.append({
+                    "seam": "window", "kind": kind,
+                    "batch": 1 + rng.randrange(WINDOW_N_BATCHES - 1),
+                })
+            elif not used_overload:
+                used_overload = True
+                events.append({
+                    "seam": "window", "kind": "overload",
+                    "batch": 1 + rng.randrange(WINDOW_N_BATCHES - 2),
+                    "level": 1 + rng.randrange(2),
+                    "batches": 2 + rng.randrange(4),
+                })
+        if not events:
+            events.append({
+                "seam": "window", "kind": "kill",
+                "batch": WINDOW_N_BATCHES // 2,
+            })
+        return ChaosSchedule(
+            seed=seed, events=tuple(events), run_deadline=30.0,
+        )
+
 
 # -- scenario ----------------------------------------------------------------
 
@@ -655,6 +763,11 @@ class ChaosReport:
     #: actually landed on, submit/resolve stamps, and the outcome
     #: ("ok" | "shed" | "fail:<Type>")
     load_records: List[dict] = field(default_factory=list)
+    #: window-seam per-close records (oracle 11's evidence): one dict
+    #: per window CLOSE observed across every resume — stream, SLO
+    #: class, [start, end), and the outcome
+    #: ("emitted" | "suppressed" | "shed")
+    windows: List[dict] = field(default_factory=list)
 
     @property
     def failing(self) -> bool:
@@ -703,6 +816,10 @@ def run_schedule(
     recovery path that silently loses bit-identity — so the oracles (and
     the shrinker on top of them) can be shown to catch a real ladder
     regression."""
+    if any(e.get("seam") == "window" for e in schedule.events):
+        return _run_window_schedule(
+            schedule, simulate_drift=simulate_drift
+        )
     if any(e.get("seam") == "load" for e in schedule.events):
         return _run_load_schedule(schedule, simulate_drift=simulate_drift)
     if any(
@@ -1778,6 +1895,393 @@ def _check_load_oracles(
     return v
 
 
+# -- window scenario (round 20) ----------------------------------------------
+
+
+def _window_analyzers():
+    """The pane-fold analyzer set: every family the windowed engine's
+    device fold supports (windows/engine.SUPPORTED_ANALYZERS), on
+    integer-valued data so sums are exact and the per-window
+    bit-identity half of oracle 11 holds across any kill/replay path."""
+    from deequ_tpu.analyzers import (
+        Completeness,
+        Maximum,
+        Mean,
+        Minimum,
+        Size,
+        Sum,
+    )
+
+    return [
+        Size(), Completeness("v"), Mean("v"), Minimum("v"), Maximum("v"),
+        Sum("v"),
+    ]
+
+
+def _window_batches(schedule: ChaosSchedule) -> Dict[str, List[dict]]:
+    """Per-stream event-time batch timelines with the schedule's DATA
+    events (late_burst / disorder_spike) already applied — a pure
+    function of the schedule, so the fault-free reference folds the
+    SAME timeline and oracle 11's bit-identity is meaningful."""
+    import numpy as np
+
+    out: Dict[str, List[dict]] = {}
+    for si, (sid, _cls, _dl, _pol) in enumerate(WINDOW_STREAM_SLO):
+        rng = np.random.default_rng(schedule.seed * 7 + si)
+        batches = []
+        for b in range(WINDOW_N_BATCHES):
+            lo = b * WINDOW_BATCH_SPAN_S
+            ts = np.sort(
+                rng.uniform(lo, lo + WINDOW_BATCH_SPAN_S, WINDOW_BATCH_ROWS)
+            )
+            v = np.floor(rng.uniform(-50.0, 51.0, WINDOW_BATCH_ROWS))
+            v[rng.random(WINDOW_BATCH_ROWS) < 0.08] = np.nan
+            batches.append({"ts": ts, "v": v})
+        out[sid] = batches
+    for e in schedule.events:
+        if e.get("seam") != "window":
+            continue
+        sid = e.get("stream")
+        b = int(e.get("batch", -1))
+        if sid not in out or not (0 <= b < WINDOW_N_BATCHES):
+            continue
+        batch = out[sid][b]
+        if e["kind"] == "late_burst":
+            k = min(int(e.get("rows", 4)), WINDOW_BATCH_ROWS)
+            ts = batch["ts"].copy()
+            ts[:k] -= float(e.get("rewind_s", 12.0))
+            batch["ts"] = ts
+        elif e["kind"] == "disorder_spike":
+            rng = np.random.default_rng(schedule.seed * 31 + b)
+            batch["ts"] = batch["ts"] + rng.uniform(
+                -float(e.get("jitter_s", 2.0)),
+                float(e.get("jitter_s", 2.0)),
+                WINDOW_BATCH_ROWS,
+            )
+    return out
+
+
+def _window_spec_policy(stream_policy: str):
+    from deequ_tpu.windows.spec import WatermarkPolicy, WindowSpec
+
+    return (
+        WindowSpec(WINDOW_SIZE_S, WINDOW_SIZE_S, time_column="ts"),
+        WatermarkPolicy(WINDOW_LAG_S, stream_policy),
+    )
+
+
+def _window_reference(
+    batch_map: Dict[str, List[dict]],
+) -> Dict[str, Dict[float, dict]]:
+    """Fault-free windowed reference: the same batch timelines through
+    fresh streams — no kills, no state store, no overload. Returns
+    stream id -> window end -> {"start", "metrics"} for every emitted
+    close (the reference emits EVERY window: nothing sheds)."""
+    from deequ_tpu.windows.engine import WindowedStream
+
+    ref: Dict[str, Dict[float, dict]] = {}
+    for sid, _cls, _dl, pol in WINDOW_STREAM_SLO:
+        spec, policy = _window_spec_policy(pol)
+        stream = WindowedStream(
+            sid, _window_analyzers(), checks=[_check()],
+            spec=spec, policy=policy, batch_rows=WINDOW_BATCH_ROWS,
+        )
+        closes = []
+        for batch in batch_map[sid]:
+            closes += stream.process_batch(batch)
+        closes += stream.flush()
+        ref[sid] = {
+            c.end: {"start": c.start, "metrics": _metric_rows(c.result)}
+            for c in closes
+            if c.emitted
+        }
+    return ref
+
+
+def _run_window_schedule(
+    schedule: ChaosSchedule, simulate_drift: bool = False
+) -> ChaosReport:
+    """The window-seam scenario: three SLO-classed windowed streams
+    fold the schedule's batch timelines through a StreamHub while the
+    schedule scripts kills (resume from the window-state store),
+    double-kill resume replays, and overload spikes; then oracle 11 +
+    1/2. Each driver tick delivers one batch per stream; a freshly
+    resumed stream catches up from its own ``next_batch_index``, so a
+    replayed interval flows through the SAME per-batch path (and its
+    already-emitted closes must hit the exactly-once fence)."""
+    import tempfile
+
+    from deequ_tpu.serve.admission import Slo
+    from deequ_tpu.windows.service import StreamHub
+
+    t0 = time.monotonic()
+    report = ChaosReport(schedule=schedule, outcome="identical")
+    batch_map = _window_batches(schedule)
+    ref = _window_reference(batch_map)
+
+    kills: Dict[int, int] = {}
+    overloads: List[Tuple[int, int, int]] = []
+    for e in schedule.events:
+        if e.get("seam") != "window":
+            continue
+        if e["kind"] == "kill":
+            kills[int(e["batch"])] = max(kills.get(int(e["batch"]), 0), 1)
+        elif e["kind"] == "resume_replay":
+            kills[int(e["batch"])] = 2
+        elif e["kind"] == "overload":
+            overloads.append((
+                int(e["batch"]), int(e.get("level", 1)),
+                int(e.get("batches", 2)),
+            ))
+
+    cls_of = {sid: cls for sid, cls, _dl, _pol in WINDOW_STREAM_SLO}
+    closes_seen: List[dict] = []
+    exc: Optional[BaseException] = None
+    resumes = 0
+    wm_regressions = 0
+    final_state: Dict[str, dict] = {}
+
+    with tempfile.TemporaryDirectory() as state_root:
+
+        def new_hub() -> StreamHub:
+            hub = StreamHub(state_root=state_root, checkpoint_every=2)
+            for sid, cls, deadline_ms, pol in WINDOW_STREAM_SLO:
+                spec, policy = _window_spec_policy(pol)
+                hub.register_stream(
+                    sid, _window_analyzers(), checks=[_check()],
+                    slo=Slo(deadline_ms=deadline_ms, cls=cls),
+                    spec=spec, policy=policy,
+                    batch_rows=WINDOW_BATCH_ROWS,
+                )
+            return hub
+
+        def record(sid: str, closes) -> None:
+            for c in closes:
+                closes_seen.append({
+                    "stream": sid, "cls": cls_of[sid],
+                    "start": c.start, "end": c.end,
+                    "outcome": (
+                        "emitted" if c.emitted
+                        else "suppressed" if c.suppressed
+                        else "shed"
+                    ),
+                    "metrics": (
+                        _metric_rows(c.result) if c.emitted else None
+                    ),
+                })
+
+        def feed_until(hub: StreamHub, tick: int, wm_seen: dict) -> None:
+            """Deliver every batch <= ``tick`` a stream has not folded
+            yet (one per tick in steady state; the catch-up replay
+            after a resume)."""
+            nonlocal wm_regressions
+            for sid in hub.stream_ids:
+                stream = hub.stream(sid)
+                while stream.next_batch_index <= tick:
+                    i = stream.next_batch_index
+                    record(sid, hub.process_batch(sid, batch_map[sid][i]))
+                    wm = stream.watermark
+                    if wm < wm_seen.get(sid, float("-inf")):
+                        wm_regressions += 1
+                    wm_seen[sid] = wm
+
+        hub = new_hub()
+        wm_seen: Dict[str, float] = {}
+        level_until = -1
+        try:
+            for tick in range(WINDOW_N_BATCHES):
+                for (at, level, span) in overloads:
+                    if at == tick:
+                        hub.set_overload(level)
+                        level_until = tick + span
+                if tick == level_until:
+                    hub.set_overload(0)
+                feed_until(hub, tick, wm_seen)
+                for _ in range(kills.get(tick, 0)):
+                    # SIGKILL equivalent: the process state is GONE —
+                    # only the window-state store survives
+                    level = hub.overload_level
+                    del hub
+                    hub = new_hub()
+                    hub.set_overload(level)
+                    resumes += 1
+                    wm_seen = {}
+            feed_until(hub, WINDOW_N_BATCHES - 1, wm_seen)
+            for sid in hub.stream_ids:
+                record(sid, hub.stream(sid).flush())
+                stream = hub.stream(sid)
+                final_state[sid] = {
+                    "late_rows": stream.late_rows,
+                    "side_ranges": len(stream.side_ranges),
+                    "sheds": len(stream.sheds),
+                    "emitted": len(stream.emitted_windows),
+                }
+        # deequ-lint: ignore[bare-except] -- the chaos driver's whole job is to observe ANY outcome; oracle 1 re-checks that it was typed
+        except BaseException as e:  # noqa: BLE001
+            exc = e
+
+    report.elapsed = time.monotonic() - t0
+    report.windows = closes_seen
+    emitted = [c for c in closes_seen if c["outcome"] == "emitted"]
+    sheds = [c for c in closes_seen if c["outcome"] == "shed"]
+    if simulate_drift and schedule.events and emitted:
+        # deliberately-broken-resume mode: one emitted metric drifts by
+        # one ulp — the bit-identity half of oracle 11 must catch it
+        for c in emitted:
+            for name, (status, value) in c["metrics"].items():
+                if status == "ok" and isinstance(value, float) and value:
+                    c["metrics"][name] = (
+                        "ok", math.nextafter(value, math.inf)
+                    )
+                    report.drifted = True
+                    break
+            if report.drifted:
+                break
+    for c in emitted:
+        for name, row in c["metrics"].items():
+            report.metrics[f"w/{c['stream']}/{c['end']:g}/{name}"] = row
+    report.fleet = {
+        "emitted": len(emitted),
+        "suppressed": sum(
+            1 for c in closes_seen if c["outcome"] == "suppressed"
+        ),
+        "sheds": len(sheds),
+        "resumes": resumes,
+        "wm_regressions": wm_regressions,
+        "late_rows": sum(s["late_rows"] for s in final_state.values()),
+        "side_ranges": sum(
+            s["side_ranges"] for s in final_state.values()
+        ),
+    }
+    if exc is not None:
+        report.outcome = f"exception:{type(exc).__name__}"
+    elif sheds or report.fleet["suppressed"]:
+        report.outcome = "degraded"
+    report.violations = _check_window_oracles(report, ref, exc)
+    return report
+
+
+def _check_window_oracles(
+    report: ChaosReport, ref: Dict[str, Dict[float, dict]], exc
+) -> List[str]:
+    """Oracle 11 (+ 1/2): every reference window emitted exactly once
+    bit-identically or shed typed; critical never sheds; sheds only
+    under a scripted overload spike; watermarks never regress; a
+    scripted late burst lands in the typed late ledgers."""
+    from deequ_tpu.exceptions import MetricCalculationException
+
+    v: List[str] = []
+    schedule = report.schedule
+
+    # 1. typed outcome
+    if exc is not None and not isinstance(exc, MetricCalculationException):
+        v.append(f"untyped outcome: {type(exc).__name__}: {exc}")
+
+    # 2. termination
+    if report.elapsed > schedule.run_deadline * 1.5 + TERMINATION_SLACK:
+        v.append(
+            f"termination: {report.elapsed:.2f}s exceeded "
+            f"run_deadline={schedule.run_deadline:g}s (+slack)"
+        )
+    if exc is not None:
+        return v  # the rest of oracle 11 compares a COMPLETED run
+
+    # 11. exactly-once window closes
+    per_stream: Dict[str, Dict[str, List[dict]]] = {}
+    for c in report.windows:
+        per_stream.setdefault(c["stream"], {}).setdefault(
+            c["outcome"], []
+        ).append(c)
+    had_overload = any(
+        e.get("seam") == "window" and e.get("kind") == "overload"
+        for e in schedule.events
+    )
+    for sid, expected in ref.items():
+        buckets = per_stream.get(sid, {})
+        emitted = buckets.get("emitted", [])
+        shed = buckets.get("shed", [])
+        emitted_ends = [c["end"] for c in emitted]
+        if len(emitted_ends) != len(set(emitted_ends)):
+            dupes = sorted(
+                e for e in set(emitted_ends)
+                if emitted_ends.count(e) > 1
+            )
+            v.append(
+                f"exactly-once: stream {sid} emitted window(s) {dupes} "
+                "more than once across kill-and-resume"
+            )
+        shed_ends = {c["end"] for c in shed}
+        if set(emitted_ends) & shed_ends:
+            v.append(
+                f"exactly-once: stream {sid} both emitted and shed "
+                f"window(s) {sorted(set(emitted_ends) & shed_ends)}"
+            )
+        covered = set(emitted_ends) | shed_ends
+        if covered != set(expected):
+            v.append(
+                f"close completeness: stream {sid} covered "
+                f"{sorted(covered)} but the fault-free reference closes "
+                f"{sorted(expected)}"
+            )
+        cls = next(
+            c for s, c, _d, _p in WINDOW_STREAM_SLO if s == sid
+        )
+        if cls == "critical" and shed:
+            v.append(
+                f"shed discipline: critical stream {sid} shed "
+                f"{sorted(shed_ends)} — critical closes on deadline "
+                "whatever the overload level"
+            )
+        if shed and not had_overload:
+            v.append(
+                f"shed discipline: stream {sid} shed {sorted(shed_ends)} "
+                "with no overload event in the schedule"
+            )
+        # bit-identity of every emitted close against the reference
+        for c in emitted:
+            exp = expected.get(c["end"])
+            if exp is None:
+                continue  # already reported by completeness
+            for name, row in (c["metrics"] or {}).items():
+                want = exp["metrics"].get(name)
+                if want is None:
+                    v.append(
+                        f"window {sid}/{c['end']:g}: metric {name} has "
+                        "no reference value"
+                    )
+                elif row[0] != want[0] or (
+                    row[0] == "ok" and not _bit_identical(row[1], want[1])
+                ):
+                    v.append(
+                        f"window {sid}/{c['end']:g}: metric {name} "
+                        f"{row!r} != fault-free reference {want!r}"
+                    )
+
+    # watermark monotonicity (within each stream incarnation)
+    if report.fleet.get("wm_regressions"):
+        v.append(
+            f"watermark: {report.fleet['wm_regressions']} regression(s) "
+            "observed — the close fence must be monotone"
+        )
+
+    # typed late routing: a scripted late burst must land in the late
+    # ledgers (dropped counts / quarantined side-output ranges)
+    had_burst = any(
+        e.get("seam") == "window"
+        and e.get("kind") == "late_burst"
+        and int(e.get("batch", 0)) >= 2
+        for e in schedule.events
+    )
+    if had_burst and not (
+        report.fleet.get("late_rows") or report.fleet.get("side_ranges")
+    ):
+        v.append(
+            "late routing: a scripted late burst left no trace in the "
+            "typed late ledgers (late_rows / side-output ranges)"
+        )
+    return v
+
+
 # -- oracles -----------------------------------------------------------------
 
 
@@ -2025,6 +2529,7 @@ def soak(
     verbose: bool = True,
     worker: bool = False,
     load: bool = False,
+    window: bool = False,
 ) -> dict:
     """Run ``n`` seeded schedules; returns a summary with every failing
     seed and its shrunk reproducer. The CI entry point
@@ -2032,13 +2537,18 @@ def soak(
     (CLI ``--worker``) soaks worker-seam schedules over the fleet
     scenario instead of the streaming one; ``load=True`` (CLI
     ``--load``) soaks load-seam schedules (scripted spikes +
-    slow-tenant stalls under oracles 1/2/3/9/10)."""
+    slow-tenant stalls under oracles 1/2/3/9/10); ``window=True``
+    (CLI ``--window``) soaks window-seam schedules (round 20: late
+    bursts, disorder, kill-and-resume, overload sheds under oracle
+    11)."""
     import sys
 
     outcomes: Dict[str, int] = {}
     failures = []
     t0 = time.monotonic()
-    if load:
+    if window:
+        generate = ChaosSchedule.generate_window
+    elif load:
         generate = ChaosSchedule.generate_load
     elif worker:
         generate = ChaosSchedule.generate_worker
@@ -2112,6 +2622,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         "scenario under oracles 1/2/3/9/10 — exactly-once incl. typed "
         "sheds, no priority inversion)",
     )
+    parser.add_argument(
+        "--window", action="store_true",
+        help="soak window-seam schedules (round 20: late bursts, "
+        "disorder spikes, mid-window kill-and-resume and overload "
+        "sheds over the three-stream windowed scenario under oracle "
+        "11 — exactly-once bit-identical closes, typed late routing, "
+        "critical streams never shed)",
+    )
     args = parser.parse_args(argv)
 
     if args.replay:
@@ -2133,7 +2651,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     n = args.n if args.soak else 20
     summary = soak(
         n=n, seed0=args.seed, simulate_drift=args.drift_sim,
-        worker=args.worker, load=args.load,
+        worker=args.worker, load=args.load, window=args.window,
     )
     print(json.dumps(summary, indent=2, default=str))
     if args.drift_sim:

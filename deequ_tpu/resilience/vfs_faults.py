@@ -6,7 +6,8 @@ goes one layer deeper: it models the five ways a single durable write
 can die at the *OS* level, and drives each seam at **every byte
 boundary** of the write against every durable store in the system —
 the request ledger, repository segments, the control-plane registry,
-and stream checkpoints — asserting the store's documented recovery
+stream checkpoints, and (round 20) the windowed-verification state
+store — asserting the store's documented recovery
 contract uniformly (typed detection, last-whole-frame/previous-version
 semantics, ``.corrupt`` forensic sidecars, never silent loss).
 
@@ -551,6 +552,72 @@ class StreamCheckpointAdapter(_FsStoreAdapter):
             )
 
 
+class WindowStateAdapter(_FsStoreAdapter):
+    """Window-state store (deequ_tpu/windows/state.py): pane stacks +
+    watermark + the exactly-once close fence, atomic + checksummed with
+    predecessor fallback — the FIFTH durable store (round 20). The
+    matrix asserts the checkpoint posture: a snapshot torn by a crash
+    falls back to its predecessor (a resumed stream replays the
+    interval and its fence suppresses the replayed closes), and the
+    attempted snapshot is visible exactly when the write physically
+    completed — a half-visible fence would either re-emit closed
+    windows (fence lost) or silently drop them (fence from the torn
+    future)."""
+
+    name = "window_state"
+    path = "crashfs://wstate"
+    fingerprint = "vfsmatrix|window|fp"
+
+    def _store(self):
+        from deequ_tpu.windows.state import WindowStateStore
+
+        return WindowStateStore(self.path, keep=4, retry=ONE_SHOT_RETRY)
+
+    @staticmethod
+    def _state(batch_index: int):
+        from deequ_tpu.windows.state import WindowState
+
+        return WindowState(
+            batch_index=batch_index,
+            watermark=float(batch_index),
+            closed_through=float(batch_index) - 10.0,
+            late_rows=batch_index,
+            emitted=[float(batch_index) - 10.0],
+            panes={float(batch_index): {"0:n": float(batch_index)}},
+        )
+
+    def baseline(self) -> None:
+        if not self._store().save(self.fingerprint, self._state(8)):
+            raise CrashpointViolation(
+                self.name, "baseline", -1,
+                "baseline window-state save failed on a healthy filesystem",
+            )
+
+    def attempt(self) -> None:
+        self._store().save(self.fingerprint, self._state(16))
+
+    def verify(self, inner, seam, cut, length, err) -> None:
+        got = self._store().load_latest(self.fingerprint)
+        if got is None:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                "no window state recoverable (baseline must survive)",
+            )
+        want = 16 if _new_write_expected(seam, cut, length) else 8
+        if got.batch_index != want:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"resumed window state from batch {got.batch_index}, "
+                f"expected {want}",
+            )
+        if got.closed_through != float(want) - 10.0:
+            raise CrashpointViolation(
+                self.name, seam, cut,
+                f"exactly-once close fence drifted: recovered "
+                f"{got.closed_through}, expected {float(want) - 10.0}",
+            )
+
+
 class RequestLedgerAdapter:
     """Request ledger: append-only frames, fsync-per-frame, raw local
     file I/O. Every crash seam leaves the same physical outcome for an
@@ -672,6 +739,7 @@ def default_adapters() -> List[Any]:
         RepositorySegmentAdapter(),
         ControlRegistryAdapter(),
         StreamCheckpointAdapter(),
+        WindowStateAdapter(),
     ]
 
 

@@ -1,0 +1,52 @@
+"""Continuous windowed verification (docs/windows.md).
+
+Event-time windows as an extra fold dimension of the fused device
+program: every open pane advances in ONE dispatch per batch
+(engine.WindowedStream), watermarks fence window closes with typed late
+routing (spec.WatermarkPolicy), pane state + the exactly-once close
+fence persist through the checksummed window-state store (state.py),
+and streams register as SLO-classed tenants whose late closes shed
+TYPED under overload (service.StreamHub).
+"""
+
+from deequ_tpu.windows.engine import (
+    SUPPORTED_ANALYZERS,
+    WINDOW_STATS,
+    WindowClose,
+    WindowedStream,
+    clear_program_cache,
+    drive,
+    pane_signature,
+)
+from deequ_tpu.windows.service import StreamHub
+from deequ_tpu.windows.spec import (
+    LATE_POLICIES,
+    WatermarkPolicy,
+    WindowSpec,
+    resolve_watermark_policy,
+    resolve_window_spec,
+)
+from deequ_tpu.windows.state import (
+    WindowState,
+    WindowStateStore,
+    stream_fingerprint,
+)
+
+__all__ = [
+    "LATE_POLICIES",
+    "SUPPORTED_ANALYZERS",
+    "WINDOW_STATS",
+    "WatermarkPolicy",
+    "WindowClose",
+    "WindowSpec",
+    "WindowState",
+    "WindowStateStore",
+    "WindowedStream",
+    "StreamHub",
+    "clear_program_cache",
+    "drive",
+    "pane_signature",
+    "resolve_watermark_policy",
+    "resolve_window_spec",
+    "stream_fingerprint",
+]

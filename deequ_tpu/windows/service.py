@@ -1,0 +1,169 @@
+"""Streams are tenants: SLO-classed registration, brownout-typed sheds.
+
+A :class:`StreamHub` owns a fleet of :class:`WindowedStream`s the way
+``VerificationService`` owns request tenants: every stream registers
+under an :class:`~deequ_tpu.serve.admission.Slo`, per-window
+VerificationResults append to the shared metrics repository and feed the
+shared :class:`~deequ_tpu.repository.monitor.QualityMonitor` at window
+close (the PR-13 save/resolve seams), and overload demotes LATE window
+closes to TYPED sheds — ``window_shed`` records charged through the
+run-budget governance ledger — while ``critical`` streams keep closing
+on deadline. A shed is never silent staleness: the close is recorded on
+the stream's shed ledger (and persists through kill-and-resume), the
+window's fence still advances (the stale verdict is dropped, not
+deferred), and the brownout signal that caused it is observable.
+
+The shed predicate is deterministic in event time: a close is LATE when
+the watermark has moved past the window end by more than the stream's
+SLO deadline (``(watermark - end) * 1000 > deadline_ms``); only late
+closes of non-critical streams shed, and only while the hub's overload
+level is raised (wire a ``BrownoutController`` via
+:meth:`update_pressure`, hand the hub a ``VerificationService`` to
+share its monitor, or drive :meth:`set_overload` directly — the chaos
+``window`` seam does the latter with scripted overload spikes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from deequ_tpu.windows.engine import WindowClose, WindowedStream
+from deequ_tpu.windows.spec import WatermarkPolicy, WindowSpec
+
+
+class StreamHub:
+    """Registry + close governor for a fleet of windowed streams."""
+
+    def __init__(
+        self,
+        repository=None,
+        monitor=None,
+        service=None,
+        brownout=None,
+        budget=None,
+        state_root: Optional[str] = None,
+        checkpoint_every: int = 4,
+        retry=None,
+    ):
+        self.repository = repository
+        self.monitor = monitor if monitor is not None else getattr(
+            service, "monitor", None
+        )
+        self.service = service
+        self.brownout = brownout
+        self.budget = budget
+        self.state_root = state_root
+        self.checkpoint_every = int(checkpoint_every)
+        self._retry = retry
+        self._overload_level = 0
+        self._streams: Dict[str, WindowedStream] = {}
+        self._lock = threading.RLock()
+        #: every typed shed the hub governed: (stream, window_end, slo cls)
+        self.sheds: List[tuple] = []
+
+    # -- overload signal --------------------------------------------------
+
+    def set_overload(self, level: int) -> None:
+        """Directly set the overload level (0 = healthy). The chaos
+        ``window`` seam scripts spikes through here."""
+        self._overload_level = max(0, int(level))
+
+    def update_pressure(self, queue_depth: int, cost_frac=None) -> int:
+        """Feed queue pressure through the wired BrownoutController (the
+        serving ladder's hysteresis) and adopt its level."""
+        if self.brownout is not None:
+            self._overload_level = int(
+                self.brownout.update(queue_depth, cost_frac)
+            )
+        return self._overload_level
+
+    @property
+    def overload_level(self) -> int:
+        return self._overload_level
+
+    def _should_shed(self, slo, lateness_s: float) -> bool:
+        if self._overload_level < 1:
+            return False
+        if slo is None or getattr(slo, "cls", "standard") == "critical":
+            # critical streams keep closing on deadline, whatever the level
+            return False
+        deadline_ms = float(getattr(slo, "deadline_ms", 0.0) or 0.0)
+        return lateness_s * 1000.0 > deadline_ms
+
+    # -- registration -----------------------------------------------------
+
+    def register_stream(
+        self,
+        stream_id: str,
+        analyzers: Sequence[Any],
+        checks: Sequence[Any] = (),
+        slo=None,
+        spec: Optional[WindowSpec] = None,
+        policy: Optional[WatermarkPolicy] = None,
+        time_column: Optional[str] = None,
+        batch_rows: Optional[int] = None,
+    ) -> WindowedStream:
+        """Register one stream under an Slo (default: the serving
+        default class). Re-registering a live stream id is refused typed
+        — two writers on one window-state directory would fence each
+        other's closes."""
+        from deequ_tpu.serve.admission import resolve_slo
+
+        with self._lock:
+            if stream_id in self._streams:
+                raise ValueError(f"stream {stream_id!r} is already registered")
+            state_dir = None
+            if self.state_root is not None:
+                state_dir = f"{self.state_root.rstrip('/')}/{stream_id}"
+            stream = WindowedStream(
+                stream_id,
+                analyzers,
+                checks=checks,
+                spec=spec,
+                policy=policy,
+                time_column=time_column,
+                state_dir=state_dir,
+                checkpoint_every=self.checkpoint_every,
+                batch_rows=batch_rows,
+                repository=self.repository,
+                monitor=self.monitor,
+                slo=resolve_slo(slo),
+                should_shed=self._should_shed,
+                budget=self.budget,
+                retry=self._retry,
+            )
+            self._streams[stream_id] = stream
+            return stream
+
+    def deregister_stream(self, stream_id: str) -> None:
+        with self._lock:
+            self._streams.pop(stream_id, None)
+
+    def stream(self, stream_id: str) -> WindowedStream:
+        with self._lock:
+            stream = self._streams.get(stream_id)
+        if stream is None:
+            raise ValueError(f"no registered stream {stream_id!r}")
+        return stream
+
+    @property
+    def stream_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._streams)
+
+    # -- batch routing ----------------------------------------------------
+
+    def process_batch(
+        self, stream_id: str, batch: Dict[str, Any]
+    ) -> List[WindowClose]:
+        """Advance one stream by one batch; shed closes are recorded on
+        the hub ledger too (the cross-stream observable)."""
+        closes = self.stream(stream_id).process_batch(batch)
+        for close in closes:
+            if close.shed:
+                cls = getattr(
+                    self.stream(stream_id).slo, "cls", "standard"
+                )
+                self.sheds.append((stream_id, close.end, cls))
+        return closes

@@ -1,0 +1,130 @@
+"""Window algebra: event-time window specs and watermark policies.
+
+A window is an event-time interval ``[start, start + size)`` whose start
+is aligned to the slide grid (``start = k * slide`` for integer ``k``).
+``slide == size`` is a tumbling window (each row in exactly one pane);
+``slide < size`` is sliding (each row in ``ceil(size/slide)`` panes).
+Panes are the unit everything downstream folds over: the device program
+advances every open pane in ONE dispatch per batch (the window fold
+axis, TiLT arXiv:2301.12030), and the close protocol emits one
+VerificationResult per pane exactly once.
+
+The watermark is the stream's bounded-disorder fence (per-stream,
+monotone): ``watermark = max(watermark, max_event_time_seen - lag_s)``.
+Windows close when ``end <= watermark``; rows with
+``event_time < watermark`` are LATE and route by the typed policy
+(``drop`` / ``side_output`` / ``refuse`` — never silently folded into a
+pane that already closed).
+
+Defaults resolve from the envcfg registry (DEEQU_TPU_WINDOW_SIZE_S /
+DEEQU_TPU_WINDOW_SLIDE_S / DEEQU_TPU_WATERMARK_LAG_S /
+DEEQU_TPU_LATE_POLICY); malformed values raise typed
+:class:`~deequ_tpu.exceptions.EnvConfigError`, never silently disable.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional
+
+LATE_POLICIES = ("drop", "side_output", "refuse")
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """One stream's window geometry (seconds of event time)."""
+
+    size_s: float
+    slide_s: float
+    time_column: str = "ts"
+
+    def __post_init__(self):
+        size = float(self.size_s)
+        slide = float(self.slide_s)
+        if not math.isfinite(size) or size <= 0.0:
+            raise ValueError(f"WindowSpec.size_s must be finite > 0, got {self.size_s!r}")
+        if not math.isfinite(slide) or slide <= 0.0:
+            raise ValueError(f"WindowSpec.slide_s must be finite > 0, got {self.slide_s!r}")
+        if slide > size:
+            raise ValueError(
+                f"WindowSpec.slide_s ({slide}) must not exceed size_s ({size}): "
+                "a slide past the size would leave event-time gaps no pane covers"
+            )
+        object.__setattr__(self, "size_s", size)
+        object.__setattr__(self, "slide_s", slide)
+
+    @property
+    def tumbling(self) -> bool:
+        return self.slide_s == self.size_s
+
+    def pane_starts_for(self, t: float) -> List[float]:
+        """Every aligned window start whose pane covers event time ``t``
+        (``start <= t < start + size``), oldest first."""
+        newest = math.floor(t / self.slide_s) * self.slide_s
+        starts: List[float] = []
+        start = newest
+        while start + self.size_s > t:
+            starts.append(start)
+            start -= self.slide_s
+        return sorted(starts)
+
+    def signature(self) -> tuple:
+        """Hashable identity for plan/lint memo keys and fingerprints."""
+        return (self.size_s, self.slide_s, self.time_column)
+
+
+@dataclass(frozen=True)
+class WatermarkPolicy:
+    """Bounded-disorder watermark: ``lag_s`` of allowed event-time
+    disorder, plus the typed routing for rows that fall behind it."""
+
+    lag_s: float
+    late_policy: str = "drop"
+
+    def __post_init__(self):
+        lag = float(self.lag_s)
+        if not math.isfinite(lag) or lag < 0.0:
+            raise ValueError(f"WatermarkPolicy.lag_s must be finite >= 0, got {self.lag_s!r}")
+        if self.late_policy not in LATE_POLICIES:
+            raise ValueError(
+                f"WatermarkPolicy.late_policy must be one of {list(LATE_POLICIES)}, "
+                f"got {self.late_policy!r}"
+            )
+        object.__setattr__(self, "lag_s", lag)
+
+    def signature(self) -> tuple:
+        return (self.lag_s, self.late_policy)
+
+
+def resolve_window_spec(
+    spec: Optional[WindowSpec] = None, time_column: str = "ts"
+) -> WindowSpec:
+    """An explicit spec wins; otherwise the envcfg defaults (tumbling
+    when DEEQU_TPU_WINDOW_SLIDE_S is unset). Malformed env values raise
+    EnvConfigError here — a stream never starts half-configured."""
+    if spec is not None:
+        if not isinstance(spec, WindowSpec):
+            raise ValueError(f"spec must be a WindowSpec, got {type(spec).__name__}")
+        return spec
+    from deequ_tpu.envcfg import env_value
+
+    size = env_value("DEEQU_TPU_WINDOW_SIZE_S")
+    slide = env_value("DEEQU_TPU_WINDOW_SLIDE_S")
+    return WindowSpec(size, size if slide is None else slide, time_column)
+
+
+def resolve_watermark_policy(
+    policy: Optional[WatermarkPolicy] = None,
+) -> WatermarkPolicy:
+    if policy is not None:
+        if not isinstance(policy, WatermarkPolicy):
+            raise ValueError(
+                f"policy must be a WatermarkPolicy, got {type(policy).__name__}"
+            )
+        return policy
+    from deequ_tpu.envcfg import env_value
+
+    return WatermarkPolicy(
+        env_value("DEEQU_TPU_WATERMARK_LAG_S"), env_value("DEEQU_TPU_LATE_POLICY")
+    )

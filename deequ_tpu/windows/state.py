@@ -1,0 +1,311 @@
+"""Crash-safe window state: pane stacks + watermark + emitted-window ledger.
+
+One :class:`WindowState` is everything a windowed stream needs to resume
+mid-window bit-identically after a SIGKILL: the per-pane leaf
+accumulators (plain f64 monoid partials — the running left fold itself,
+so a resumed merge uses the exact association an uninterrupted run
+would), the monotone watermark, the late/side-output ledgers, and the
+exactly-once close fence (``closed_through`` + the emitted-window
+ledger): a resumed stream suppresses every replayed close at or below
+the fence and re-emits NOTHING.
+
+Persistence rides the PR-2 checksummed checkpoint machinery
+(resilience/atomic.py): versioned files ``wstate_<seq>.dqws`` inside a
+checksum envelope, written atomically, the last ``keep`` retained so a
+write torn by a crash falls back to its predecessor — the same
+fallback contract the crashpoint matrix (resilience/vfs_faults.py)
+verifies for the stream-checkpoint store, which this store joins as the
+fifth durable surface. Saves are best-effort by contract: a failed save
+is COUNTED and degrades resumability, never correctness. The close-time
+save is NOT best-effort in spirit — the engine persists the advanced
+close fence BEFORE emitting, so a crash between fence and emit costs an
+alert (at-most-once for that tail), never a duplicate.
+
+Format: ``DQWN | version(u16) | fingerprint | seq(i64) | batch_index(i64)
+| watermark(f64) | closed_through(f64) | late_rows(i64) | side ranges |
+shed ledger | emitted ledger | panes`` in a checksum envelope.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from deequ_tpu.exceptions import CorruptStateException
+from deequ_tpu.resilience.atomic import (
+    atomic_write_bytes,
+    read_checksummed,
+    wrap_checksum,
+)
+
+MAGIC = b"DQWN"
+VERSION = 1
+
+_u16 = struct.Struct("<H")
+_i64 = struct.Struct("<q")
+_f64 = struct.Struct("<d")
+
+#: emitted-window ledger entries retained in full; older closes are
+#: summarized by the fence (closed_through) alone, which is all the
+#: exactly-once suppression needs
+LEDGER_CAP = 256
+
+
+def _pack_str(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return _i64.pack(len(raw)) + raw
+
+
+def _unpack_str(buf: bytes, off: int) -> Tuple[str, int]:
+    (n,) = _i64.unpack_from(buf, off)
+    off += 8
+    return buf[off:off + n].decode("utf-8"), off + n
+
+
+@dataclass
+class WindowState:
+    """One recovered snapshot of a windowed stream (see module doc)."""
+
+    batch_index: int = 0
+    watermark: float = float("-inf")
+    #: the exactly-once close fence: highest window end ever EMITTED (or
+    #: shed typed) — a resumed replay suppresses closes at or below it
+    closed_through: float = float("-inf")
+    late_rows: int = 0
+    #: quarantined [start, stop) global row ranges (side_output policy)
+    side_ranges: List[Tuple[int, int]] = field(default_factory=list)
+    #: typed sheds: (window_end, slo_class) — closes the brownout dropped
+    shed: List[Tuple[float, str]] = field(default_factory=list)
+    #: emitted-window ledger: window ends, in emit order (capped)
+    emitted: List[float] = field(default_factory=list)
+    #: open pane accumulators: window start -> {leaf key: f64 partial}
+    panes: Dict[float, Dict[str, float]] = field(default_factory=dict)
+
+
+def _encode(fingerprint: str, seq: int, state: WindowState) -> bytes:
+    out = [MAGIC, _u16.pack(VERSION), _pack_str(fingerprint)]
+    out.append(_i64.pack(seq))
+    out.append(_i64.pack(state.batch_index))
+    out.append(_f64.pack(state.watermark))
+    out.append(_f64.pack(state.closed_through))
+    out.append(_i64.pack(state.late_rows))
+    out.append(_i64.pack(len(state.side_ranges)))
+    for start, stop in state.side_ranges:
+        out.append(_i64.pack(start))
+        out.append(_i64.pack(stop))
+    out.append(_i64.pack(len(state.shed)))
+    for end, cls in state.shed:
+        out.append(_f64.pack(end))
+        out.append(_pack_str(cls))
+    emitted = state.emitted[-LEDGER_CAP:]
+    out.append(_i64.pack(len(emitted)))
+    for end in emitted:
+        out.append(_f64.pack(end))
+    out.append(_i64.pack(len(state.panes)))
+    for start in sorted(state.panes):
+        leaves = state.panes[start]
+        out.append(_f64.pack(start))
+        out.append(_i64.pack(len(leaves)))
+        for key in sorted(leaves):
+            out.append(_pack_str(key))
+            out.append(_f64.pack(leaves[key]))
+    return b"".join(out)
+
+
+def _decode(payload: bytes, what: str) -> Tuple[str, int, WindowState]:
+    if payload[:4] != MAGIC:
+        raise CorruptStateException(what, "bad window-state magic")
+    (version,) = _u16.unpack_from(payload, 4)
+    if version > VERSION:
+        raise CorruptStateException(
+            what, f"window-state version {version} newer than supported {VERSION}"
+        )
+    off = 6
+    fingerprint, off = _unpack_str(payload, off)
+    (seq,) = _i64.unpack_from(payload, off); off += 8
+    state = WindowState()
+    (state.batch_index,) = _i64.unpack_from(payload, off); off += 8
+    (state.watermark,) = _f64.unpack_from(payload, off); off += 8
+    (state.closed_through,) = _f64.unpack_from(payload, off); off += 8
+    (state.late_rows,) = _i64.unpack_from(payload, off); off += 8
+    (n_ranges,) = _i64.unpack_from(payload, off); off += 8
+    for _ in range(n_ranges):
+        (start,) = _i64.unpack_from(payload, off); off += 8
+        (stop,) = _i64.unpack_from(payload, off); off += 8
+        state.side_ranges.append((start, stop))
+    (n_shed,) = _i64.unpack_from(payload, off); off += 8
+    for _ in range(n_shed):
+        (end,) = _f64.unpack_from(payload, off); off += 8
+        cls, off = _unpack_str(payload, off)
+        state.shed.append((end, cls))
+    (n_emitted,) = _i64.unpack_from(payload, off); off += 8
+    for _ in range(n_emitted):
+        (end,) = _f64.unpack_from(payload, off); off += 8
+        state.emitted.append(end)
+    (n_panes,) = _i64.unpack_from(payload, off); off += 8
+    for _ in range(n_panes):
+        (start,) = _f64.unpack_from(payload, off); off += 8
+        (n_leaves,) = _i64.unpack_from(payload, off); off += 8
+        leaves: Dict[str, float] = {}
+        for _ in range(n_leaves):
+            key, off = _unpack_str(payload, off)
+            (val,) = _f64.unpack_from(payload, off); off += 8
+            leaves[key] = val
+        state.panes[start] = leaves
+    return fingerprint, seq, state
+
+
+class WindowStateStore:
+    """Owns one window-state directory for one logical stream.
+
+    ``fingerprint`` ties snapshots to the stream's configuration
+    (analyzer set + window geometry + batch geometry): a snapshot
+    written under a different fingerprint is ignored on resume rather
+    than folded into the wrong stream. The last ``keep`` snapshots are
+    retained so a snapshot torn by a crash falls back to its
+    predecessor.
+    """
+
+    def __init__(self, directory: str, keep: int = 2, retry=None):
+        from deequ_tpu.data.fs import filesystem_for, strip_scheme
+        from deequ_tpu.resilience.retry import RetryingFileSystem
+
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = strip_scheme(directory)
+        self.keep = int(keep)
+        self._fs = RetryingFileSystem(filesystem_for(directory), retry)
+        self._retry = retry
+        self._seq = 0
+        # telemetry for tests/bench: how many saves happened / failed
+        self.saves = 0
+        self.save_failures = 0
+
+    def _path(self, seq: int) -> str:
+        return self._fs.join(self.directory, f"wstate_{seq:010d}.dqws")
+
+    def _list(self) -> List[str]:
+        if not self._fs.exists(self.directory):
+            return []
+        return [
+            n
+            for n in self._fs.listdir(self.directory)
+            if n.startswith("wstate_") and n.endswith(".dqws")
+        ]
+
+    def _resync_seq(self) -> None:
+        """Advance the write sequence past every snapshot on disk so a
+        writer never reuses (and silently overwrites) a live sequence
+        number — a resumed process and the crashpoint adapter both
+        construct fresh stores over an existing directory."""
+        try:
+            names = self._list()
+        # deequ-lint: ignore[bare-except] -- an unlistable store degrades to seq 0; the atomic write itself still cannot tear an existing file
+        except Exception:  # noqa: BLE001 — unlistable: keep current seq
+            return
+        for name in names:
+            try:
+                self._seq = max(
+                    self._seq, int(name[len("wstate_"):-len(".dqws")])
+                )
+            except ValueError:
+                continue
+
+    def save(self, fingerprint: str, state: WindowState) -> bool:
+        """Persist one snapshot (atomic + checksummed). Returns False —
+        and keeps the stream alive — when storage refuses past retries:
+        a failed save degrades resumability, not correctness (the engine
+        checks the return value at CLOSE-time saves and refuses to treat
+        an unpersisted fence as advanced)."""
+        if self._seq == 0:
+            self._resync_seq()
+        self._seq += 1
+        try:
+            payload = wrap_checksum(_encode(fingerprint, self._seq, state))
+            self._fs.makedirs(self.directory)
+            atomic_write_bytes(
+                self._fs, self._path(self._seq), payload,
+                retry=self._retry,
+                what=f"window state seq {self._seq}",
+            )
+        # deequ-lint: ignore[bare-except] -- window-state saves are best-effort by contract: a failed save is COUNTED (save_failures) and the stream continues; the engine treats a failed CLOSE-time save as an unadvanced fence
+        except Exception:  # noqa: BLE001 — saving is best-effort
+            self.save_failures += 1
+            return False
+        self.saves += 1
+        self._prune()
+        return True
+
+    def _prune(self) -> None:
+        try:
+            names = sorted(self._list())
+        # deequ-lint: ignore[bare-except] -- pruning is housekeeping; an unlistable store must not fail the stream
+        except Exception:  # noqa: BLE001 — pruning is housekeeping only
+            return
+        for stale in names[: max(len(names) - self.keep, 0)]:
+            try:
+                self._fs.delete(self._fs.join(self.directory, stale))
+            # deequ-lint: ignore[bare-except] -- stale snapshot files are harmless; deletion is best-effort
+            except Exception:  # noqa: BLE001 — stale files are harmless
+                pass
+
+    def load_latest(self, fingerprint: str) -> Optional[WindowState]:
+        """Newest valid snapshot matching ``fingerprint`` — corrupt or
+        mismatched files are skipped (falling back to older ones), never
+        fatal: worst case the stream restarts from batch 0. Resyncs the
+        store's write sequence past every file seen so a resumed writer
+        never reuses a live sequence number."""
+        try:
+            names = sorted(self._list(), reverse=True)
+        # deequ-lint: ignore[bare-except] -- unreachable store degrades to a fresh stream (documented load_latest contract)
+        except Exception:  # noqa: BLE001 — unreachable store: start fresh
+            return None
+        self._resync_seq()
+        for name in names:
+            path = self._fs.join(self.directory, name)
+            try:
+                payload = read_checksummed(
+                    self._fs, path, f"window state {name}", retry=self._retry
+                )
+                found_fp, seq, state = _decode(payload, f"window state {name}")
+            # deequ-lint: ignore[bare-except] -- damaged snapshots fall back to older ones; CorruptStateException is typed upstream
+            except Exception:  # noqa: BLE001 — damaged snapshot: fall back
+                continue
+            if found_fp != fingerprint:
+                continue
+            return state
+        return None
+
+    def clear(self) -> None:
+        """Drop all snapshots (a completed/abandoned stream's cleanup)."""
+        try:
+            names = self._list()
+        # deequ-lint: ignore[bare-except] -- unreachable store means nothing to clear; best-effort
+        except Exception:  # noqa: BLE001 — unreachable store: nothing kept
+            return
+        for name in names:
+            try:
+                self._fs.delete(self._fs.join(self.directory, name))
+            # deequ-lint: ignore[bare-except] -- per-file deletion during clear() is best-effort
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def stream_fingerprint(
+    stream_id: str,
+    analyzer_keys,
+    window_signature: tuple,
+    policy_signature: tuple,
+    batch_rows: Optional[int],
+) -> str:
+    """Stable identity of a windowed stream's fold configuration: the
+    analyzer set, the window/watermark geometry, and the batch geometry
+    (batch boundaries must match for a resumed fold to be meaningful)."""
+    import hashlib
+
+    basis = repr((
+        str(stream_id), sorted(str(k) for k in analyzer_keys),
+        tuple(window_signature), tuple(policy_signature), batch_rows,
+    )).encode()
+    return hashlib.sha1(basis).hexdigest()

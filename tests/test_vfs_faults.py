@@ -215,10 +215,11 @@ def test_full_crashpoint_matrix_every_seam_every_byte():
     assert set(summary["stores"]) == {
         "request_ledger", "repository_segment",
         "control_registry", "stream_checkpoint",
+        "window_state",
     }
     for name, store in summary["stores"].items():
         assert store["cells"] >= store["write_len"], name
-        # the four FileSystem-backed stores cover all five seams; the
+        # the FileSystem-backed stores cover all five seams; the
         # ledger's physical-equivalence column covers torn_tail
         if name != "request_ledger":
             assert set(store["by_seam"]) == {
@@ -235,4 +236,5 @@ def test_default_adapters_cover_every_durable_store():
     assert names == {
         "request_ledger", "repository_segment",
         "control_registry", "stream_checkpoint",
+        "window_state",
     }

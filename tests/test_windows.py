@@ -1,0 +1,611 @@
+"""Continuous windowed verification (tier-1 ``wstream`` suite; round 20).
+
+What is pinned here:
+
+- BIT-IDENTITY VS ONE-SHOT: every emitted window's metrics are
+  bit-identical (``struct.pack('<d')``) to a one-shot
+  ``VerificationSuite`` run over exactly that window's rows — tumbling
+  AND sliding, through NaN nulls;
+- ONE DISPATCH PER BATCH: the pane fold advances EVERY open pane in one
+  device dispatch (``pane_dispatches`` grows by exactly 1 per batch, no
+  matter how many panes a sliding spec keeps open), and streams sharing
+  a (signature, geometry, shape) share ONE traced program;
+- WATERMARK MONOTONICITY: the watermark never regresses through
+  disorder, and trails the max observed event time by exactly ``lag_s``;
+- TYPED LATE ROUTING: ``drop`` counts (stream + ScanStats ledgers),
+  ``side_output`` quarantines batch-aligned row ranges on the
+  partial-result surface (``kind="late_side_output"``), ``refuse``
+  raises :class:`LateDataException` ATOMICALLY (no state advanced);
+- KILL-AND-RESUME: a stream rebuilt from its state dir mid-window
+  resumes bit-identically and delivers every window close exactly once
+  through a DOUBLE resume — zero duplicate monitor alerts;
+- THE CLOSE FENCE: a replayed close at or below ``closed_through`` is
+  suppressed (counted, ``result=None``, nothing re-observed) — the
+  defense-in-depth rail behind the exactly-once claim;
+- OVERLOAD SHEDS ARE TYPED: under a raised hub overload level, late
+  closes of non-critical streams shed as ``window_shed`` charged through
+  the governance ledger while critical streams keep closing; the shed
+  advances the fence (dropped, not deferred) and persists through resume;
+- CRASH-SAFE STATE: the window-state store passes the crashpoint matrix
+  (every write seam, fence value intact) as the fifth durable store;
+- CONFIG + LINT: the four DEEQU_TPU_WINDOW*/LATE_POLICY knobs validate
+  typed through the envcfg registry, and the ``plan-window-refeed`` lint
+  rule passes the real pane program (traced, armed ``error``) while
+  catching drifted geometry/policy/fold-tag declarations.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deequ_tpu.analyzers import Completeness, Maximum, Mean, Minimum, Size, Sum
+from deequ_tpu.data.table import ColumnarTable
+from deequ_tpu.envcfg import EnvConfigError, registry_snapshot
+from deequ_tpu.exceptions import LateDataException
+from deequ_tpu.ops.scan_engine import SCAN_STATS
+from deequ_tpu.resilience.governance import RunPolicy
+from deequ_tpu.serve.admission import Slo
+from deequ_tpu.verification import VerificationSuite
+from deequ_tpu.windows import (
+    LATE_POLICIES,
+    WINDOW_STATS,
+    StreamHub,
+    WatermarkPolicy,
+    WindowSpec,
+    WindowState,
+    WindowStateStore,
+    WindowedStream,
+    drive,
+    pane_signature,
+    resolve_watermark_policy,
+    resolve_window_spec,
+)
+
+pytestmark = pytest.mark.wstream
+
+ANALYZERS = (
+    Size(), Completeness("v"), Mean("v"), Minimum("v"), Maximum("v"), Sum("v"),
+)
+
+
+def _bits(v: float) -> bytes:
+    return struct.pack("<d", float(v))
+
+
+def _metric_rows(result):
+    """{analyzer-name: ('ok', bits) | ('fail', exc-type)} — the chaos
+    suite's extraction idiom (metric.value is a Success/Failure wrapper,
+    never a bare float)."""
+    rows = {}
+    for analyzer, metric in result.metrics.items():
+        if metric.value.is_success:
+            rows[str(analyzer)] = ("ok", _bits(metric.value.get()))
+        else:
+            rows[str(analyzer)] = ("fail", type(metric.value.exception).__name__)
+    return rows
+
+
+def _batches(n_batches=6, rows=32, span=5.0, seed=7, jitter=0.0):
+    """Deterministic host batches: in-order event time (optional
+    disorder jitter), values with NaN nulls."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(rng.uniform(b * span, (b + 1) * span, rows))
+        if jitter:
+            ts = ts + rng.uniform(0.0, jitter, rows)
+        v = np.floor(rng.uniform(-40.0, 41.0, rows))
+        v[rng.uniform(0.0, 1.0, rows) < 0.1] = np.nan
+        out.append({"ts": ts, "v": v})
+    return out
+
+
+def _one_shot_rows(batches, start, end):
+    ts = np.concatenate([b["ts"] for b in batches])
+    v = np.concatenate([b["v"] for b in batches])
+    keep = (ts >= start) & (ts < end)
+    return [None if np.isnan(x) else float(x) for x in v[keep]]
+
+
+def _one_shot_reference(batches, closes):
+    """One independent VerificationSuite run per emitted window."""
+    ref = {}
+    for c in closes:
+        if not c.emitted:
+            continue
+        vals = _one_shot_rows(batches, c.start, c.end)
+        result = (
+            VerificationSuite()
+            .on_data(ColumnarTable.from_pydict({"v": vals}))
+            .add_required_analyzers(list(ANALYZERS))
+            .run()
+        )
+        ref[(c.start, c.end)] = _metric_rows(result)
+    return ref
+
+
+class _RecordingMonitor:
+    """Counts observe_verification deliveries — the duplicate-alert probe."""
+
+    def __init__(self):
+        self.seen = []
+
+    def observe_verification(self, stream_id, result):
+        self.seen.append(stream_id)
+
+
+# -- window algebra -----------------------------------------------------------
+
+
+def test_spec_and_policy_validation_typed():
+    with pytest.raises(ValueError, match="size_s"):
+        WindowSpec(0.0, 1.0)
+    with pytest.raises(ValueError, match="slide_s"):
+        WindowSpec(10.0, float("nan"))
+    with pytest.raises(ValueError, match="must not exceed"):
+        WindowSpec(10.0, 20.0)
+    with pytest.raises(ValueError, match="lag_s"):
+        WatermarkPolicy(-1.0)
+    with pytest.raises(ValueError, match="late_policy"):
+        WatermarkPolicy(2.0, "teleport")
+    assert WindowSpec(10.0, 10.0).tumbling
+    assert not WindowSpec(10.0, 5.0).tumbling
+
+
+def test_pane_starts_cover_sliding_grid():
+    spec = WindowSpec(10.0, 5.0)
+    # t=12 belongs to [5,15) and [10,20)
+    assert spec.pane_starts_for(12.0) == [5.0, 10.0]
+    tumble = WindowSpec(10.0, 10.0)
+    assert tumble.pane_starts_for(12.0) == [10.0]
+
+
+def test_unsupported_analyzer_refused_typed():
+    from deequ_tpu.analyzers import ApproxQuantile
+
+    with pytest.raises(ValueError, match="window fold axis"):
+        pane_signature([ApproxQuantile("v", 0.5)])
+    with pytest.raises(ValueError, match="at least one analyzer"):
+        WindowedStream("s", [])
+
+
+# -- bit-identity vs one-shot -------------------------------------------------
+
+
+@pytest.mark.parametrize("slide", [10.0, 5.0], ids=["tumbling", "sliding"])
+def test_windows_bit_identical_to_one_shot(slide):
+    batches = _batches()
+    stream = WindowedStream(
+        "s1", ANALYZERS, spec=WindowSpec(10.0, slide),
+        policy=WatermarkPolicy(2.0, "drop"),
+    )
+    closes = drive(stream, batches, flush=True)
+    emitted = [c for c in closes if c.emitted]
+    assert len(emitted) >= 3
+    ref = _one_shot_reference(batches, emitted)
+    for c in emitted:
+        assert _metric_rows(c.result) == ref[(c.start, c.end)]
+
+
+def test_one_dispatch_per_batch_and_shared_program():
+    from deequ_tpu.windows.engine import clear_program_cache
+
+    clear_program_cache()
+    batches = _batches(n_batches=5)
+    before = WINDOW_STATS.snapshot()
+    spec = WindowSpec(20.0, 5.0)  # 4 concurrently-open panes
+    s1 = WindowedStream("a", ANALYZERS, spec=spec, policy=WatermarkPolicy(2.0))
+    drive(s1, batches)
+    mid = WINDOW_STATS.snapshot()
+    assert mid["pane_dispatches"] - before["pane_dispatches"] == len(batches)
+    # a second stream with the same shape pays ZERO new traces
+    s2 = WindowedStream("b", ANALYZERS, spec=spec, policy=WatermarkPolicy(2.0))
+    drive(s2, batches)
+    after = WINDOW_STATS.snapshot()
+    assert after["programs_built"] == mid["programs_built"]
+    assert after["pane_dispatches"] - mid["pane_dispatches"] == len(batches)
+
+
+# -- watermark + typed late routing -------------------------------------------
+
+
+def test_watermark_monotone_and_lagged_under_disorder():
+    batches = _batches(jitter=3.0, seed=11)
+    stream = WindowedStream(
+        "wm", ANALYZERS, spec=WindowSpec(10.0, 10.0),
+        policy=WatermarkPolicy(2.5, "drop"),
+    )
+    seen_max = float("-inf")
+    prev = stream.watermark
+    for b in batches:
+        stream.process_batch(b)
+        assert stream.watermark >= prev
+        prev = stream.watermark
+        seen_max = max(seen_max, float(np.max(b["ts"])))
+        assert _bits(stream.watermark) == _bits(seen_max - 2.5)
+
+
+def _late_batches():
+    """Batch 2 rewinds 6 rows far behind the watermark."""
+    batches = _batches(n_batches=4, seed=13)
+    late = dict(batches[2])
+    ts = late["ts"].copy()
+    ts[:6] = ts[:6] - 14.0
+    late["ts"] = ts
+    batches[2] = late
+    return batches
+
+
+def test_late_policy_drop_counts_everywhere():
+    batches = _late_batches()
+    stream = WindowedStream(
+        "drop", ANALYZERS, spec=WindowSpec(10.0, 10.0),
+        policy=WatermarkPolicy(1.0, "drop"),
+    )
+    scan_before = SCAN_STATS.snapshot()["late_rows"]
+    closes = drive(stream, batches, flush=True)
+    assert stream.late_rows == 6
+    assert SCAN_STATS.snapshot()["late_rows"] - scan_before == 6
+    assert stream.side_ranges == []
+    # the late rows are DROPPED from the fold: window 2's close matches a
+    # one-shot over the surviving (non-late) rows only
+    live = batches[:2] + [
+        {"ts": batches[2]["ts"][6:], "v": batches[2]["v"][6:]}
+    ] + batches[3:]
+    ref = _one_shot_reference(live, [c for c in closes if c.emitted])
+    for c in closes:
+        if c.emitted:
+            assert _metric_rows(c.result) == ref[(c.start, c.end)]
+
+
+def test_late_policy_side_output_quarantines_ranges():
+    batches = _late_batches()
+    stream = WindowedStream(
+        "side", ANALYZERS, spec=WindowSpec(10.0, 10.0),
+        policy=WatermarkPolicy(1.0, "side_output"), batch_rows=32,
+    )
+    drive(stream, batches, flush=True)
+    # batch-aligned quarantine: batch 2 spans global rows [64, 96)
+    assert stream.side_ranges == [(64, 96)]
+    ranges = SCAN_STATS.snapshot()["unverified_row_ranges"]
+    assert any(r[0] == 64 and r[1] == 96 for r in ranges)
+
+
+def test_late_policy_refuse_raises_atomically():
+    batches = _late_batches()
+    stream = WindowedStream(
+        "refuse", ANALYZERS, spec=WindowSpec(10.0, 10.0),
+        policy=WatermarkPolicy(1.0, "refuse"),
+    )
+    drive(stream, batches[:2])
+    before = (
+        stream.next_batch_index, stream.watermark,
+        stream.open_panes, stream.late_rows,
+    )
+    with pytest.raises(LateDataException) as exc_info:
+        stream.process_batch(batches[2])
+    exc = exc_info.value
+    assert exc.stream == "refuse" and exc.late_rows == 6
+    assert exc.oldest_event_time < exc.watermark
+    # ATOMIC: the refused batch advanced nothing
+    assert (
+        stream.next_batch_index, stream.watermark,
+        stream.open_panes, stream.late_rows,
+    ) == before
+
+
+# -- kill-and-resume ----------------------------------------------------------
+
+
+def test_kill_and_resume_bit_identical_exactly_once_double_resume(tmp_path):
+    batches = _batches(n_batches=8, seed=17)
+    spec = WindowSpec(10.0, 5.0)
+    policy = WatermarkPolicy(2.0, "drop")
+
+    ref_monitor = _RecordingMonitor()
+    reference = WindowedStream(
+        "kr", ANALYZERS, spec=spec, policy=policy, monitor=ref_monitor,
+    )
+    ref_closes = [c for c in drive(reference, batches, flush=True) if c.emitted]
+
+    state_dir = str(tmp_path / "kr")
+    monitor = _RecordingMonitor()
+
+    def revive():
+        return WindowedStream(
+            "kr", ANALYZERS, spec=spec, policy=policy, monitor=monitor,
+            state_dir=state_dir, checkpoint_every=2, batch_rows=32,
+        )
+
+    emitted = []
+    stream = revive()
+    assert not stream.resumed
+    for kill_at in (3, 6):  # mid-window on the 5s slide grid
+        while stream.next_batch_index < kill_at:
+            emitted.extend(
+                c for c in stream.process_batch(batches[stream.next_batch_index])
+                if c.emitted
+            )
+        del stream  # SIGKILL equivalent: process state GONE, store survives
+        stream = revive()
+        assert stream.resumed
+    while stream.next_batch_index < len(batches):
+        emitted.extend(
+            c for c in stream.process_batch(batches[stream.next_batch_index])
+            if c.emitted
+        )
+    emitted.extend(c for c in stream.flush() if c.emitted)
+
+    # exactly-once: same windows, once each, bit-identical metrics
+    assert [(c.start, c.end) for c in emitted] == [
+        (c.start, c.end) for c in ref_closes
+    ]
+    for got, want in zip(emitted, ref_closes):
+        assert _metric_rows(got.result) == _metric_rows(want.result)
+    # zero duplicate alerts through the double resume
+    assert len(monitor.seen) == len(ref_monitor.seen) == len(ref_closes)
+
+
+def test_close_fence_suppresses_replayed_close(tmp_path):
+    """The defense-in-depth rail: a pane whose end is at or below the
+    recovered ``closed_through`` fence (the state a replaying writer
+    would rebuild) closes SUPPRESSED — counted, ``result=None``, no
+    monitor delivery, never re-emitted."""
+    store = WindowStateStore(str(tmp_path / "fence"))
+    fingerprint = None
+    monitor = _RecordingMonitor()
+
+    probe = WindowedStream(
+        "fence", ANALYZERS, spec=WindowSpec(10.0, 10.0),
+        policy=WatermarkPolicy(2.0, "drop"),
+    )
+    fingerprint = probe.fingerprint
+    # a snapshot claiming [0,10) already emitted, with its pane rebuilt
+    replayed = WindowState(
+        batch_index=1, watermark=8.0, closed_through=10.0,
+        emitted=[10.0], panes={0.0: {}},
+    )
+    assert store.save(fingerprint, replayed)
+
+    stream = WindowedStream(
+        "fence", ANALYZERS, spec=WindowSpec(10.0, 10.0),
+        policy=WatermarkPolicy(2.0, "drop"), monitor=monitor,
+        state_dir=str(tmp_path / "fence"),
+    )
+    assert stream.resumed and stream.closed_through == 10.0
+    before = WINDOW_STATS.snapshot()["closes_suppressed"]
+    ts = np.array([11.0, 12.5, 14.0])
+    closes = stream.process_batch({"ts": ts, "v": np.array([1.0, 2.0, 3.0])})
+    suppressed = [c for c in closes if c.suppressed]
+    assert len(suppressed) == 1
+    assert suppressed[0].end == 10.0 and suppressed[0].result is None
+    assert WINDOW_STATS.snapshot()["closes_suppressed"] == before + 1
+    assert monitor.seen == []  # nothing re-observed
+    assert stream.emitted_windows == [10.0]  # ledger unchanged
+
+
+# -- streams are tenants: overload sheds --------------------------------------
+
+
+def _hub_batches():
+    """An event-time gap: [0,10) closes only when the stream jumps to
+    t=50, so its close is ~38s late — past a 1s deadline, inside 60s."""
+    rng = np.random.default_rng(23)
+    early = {
+        "ts": np.sort(rng.uniform(0.0, 9.0, 16)),
+        "v": np.floor(rng.uniform(-10.0, 11.0, 16)),
+    }
+    late = {
+        "ts": np.sort(rng.uniform(50.0, 55.0, 16)),
+        "v": np.floor(rng.uniform(-10.0, 11.0, 16)),
+    }
+    return [early, late]
+
+
+def test_overload_sheds_late_closes_typed_critical_unaffected(tmp_path):
+    budget = RunPolicy(max_total_attempts=64).arm()
+    hub = StreamHub(budget=budget, state_root=str(tmp_path / "hub"))
+    hub.register_stream(
+        "crit", ANALYZERS, slo=Slo(deadline_ms=1000.0, cls="critical"),
+        spec=WindowSpec(10.0, 10.0), policy=WatermarkPolicy(2.0, "drop"),
+    )
+    hub.register_stream(
+        "std", ANALYZERS, slo=Slo(deadline_ms=1000.0, cls="standard"),
+        spec=WindowSpec(10.0, 10.0), policy=WatermarkPolicy(2.0, "drop"),
+    )
+    with pytest.raises(ValueError, match="already registered"):
+        hub.register_stream("std", ANALYZERS)
+
+    hub.set_overload(1)
+    shed_ends = []
+    for sid in ("crit", "std"):
+        for batch in _hub_batches():
+            for c in hub.process_batch(sid, batch):
+                if c.shed:
+                    shed_ends.append((sid, c.end))
+                    assert c.result is None and not c.emitted
+    # the standard stream's very-late close shed typed; critical emitted
+    assert ("std", 10.0) in shed_ends
+    assert all(sid != "crit" for sid, _ in shed_ends)
+    crit, std = hub.stream("crit"), hub.stream("std")
+    assert 10.0 in crit.emitted_windows
+    assert 10.0 not in std.emitted_windows
+    assert ("std", 10.0, "standard") in hub.sheds
+    assert std.sheds and std.sheds[0][0] == 10.0
+    # shed = dropped, not deferred: the fence advanced past the window
+    assert std.closed_through >= 10.0
+    # charged through the governance ledger, typed
+    assert budget.charges.get("window_shed", 0) == len(shed_ends)
+
+    # the shed ledger survives kill-and-resume
+    hub2 = StreamHub(state_root=str(tmp_path / "hub"))
+    resumed = hub2.register_stream(
+        "std", ANALYZERS, slo=Slo(deadline_ms=1000.0, cls="standard"),
+        spec=WindowSpec(10.0, 10.0), policy=WatermarkPolicy(2.0, "drop"),
+    )
+    assert resumed.resumed and resumed.sheds == std.sheds
+
+    # healthy hubs never shed, whatever the lateness
+    calm = StreamHub()
+    calm.register_stream(
+        "std", ANALYZERS, slo=Slo(deadline_ms=1000.0, cls="standard"),
+        spec=WindowSpec(10.0, 10.0), policy=WatermarkPolicy(2.0, "drop"),
+    )
+    for batch in _hub_batches():
+        for c in calm.process_batch("std", batch):
+            assert not c.shed
+
+
+# -- crash-safe state ---------------------------------------------------------
+
+
+def test_window_state_round_trip_and_fingerprint(tmp_path):
+    store = WindowStateStore(str(tmp_path / "st"))
+    state = WindowState(
+        batch_index=5, watermark=22.5, closed_through=20.0, late_rows=3,
+        side_ranges=[(64, 96)], shed=[(15.0, "standard")],
+        emitted=[10.0, 20.0], panes={20.0: {"0:n": 7.0, "3:value": -2.5}},
+    )
+    assert store.save("fp|a", state)
+    got = store.load_latest("fp|a")
+    assert got == state
+    # a different fingerprint never resumes from this snapshot
+    assert store.load_latest("fp|b") is None
+
+
+def test_crashpoint_matrix_window_store():
+    from deequ_tpu.resilience.vfs_faults import (
+        WindowStateAdapter,
+        default_adapters,
+        run_crashpoint_matrix,
+    )
+
+    assert any(
+        type(a).__name__ == "WindowStateAdapter" for a in default_adapters()
+    ), "the window-state store must ride the default crashpoint matrix"
+    summary = run_crashpoint_matrix(adapters=[WindowStateAdapter()], stride=5)
+    cells = summary["stores"]["window_state"]["cells"]
+    assert cells > 0 and summary["cells"] == cells
+
+
+# -- envcfg knobs -------------------------------------------------------------
+
+
+def test_window_env_knobs_resolve_and_validate(monkeypatch):
+    for name in (
+        "DEEQU_TPU_WINDOW_SIZE_S", "DEEQU_TPU_WINDOW_SLIDE_S",
+        "DEEQU_TPU_WATERMARK_LAG_S", "DEEQU_TPU_LATE_POLICY",
+    ):
+        monkeypatch.delenv(name, raising=False)
+        assert name in registry_snapshot()
+    spec = resolve_window_spec(None)
+    assert spec.size_s == 60.0 and spec.tumbling
+    policy = resolve_watermark_policy(None)
+    assert policy.lag_s == 5.0 and policy.late_policy == "drop"
+
+    monkeypatch.setenv("DEEQU_TPU_WINDOW_SIZE_S", "30")
+    monkeypatch.setenv("DEEQU_TPU_WINDOW_SLIDE_S", "15")
+    monkeypatch.setenv("DEEQU_TPU_WATERMARK_LAG_S", "0")
+    monkeypatch.setenv("DEEQU_TPU_LATE_POLICY", "side_output")
+    spec = resolve_window_spec(None)
+    assert (spec.size_s, spec.slide_s) == (30.0, 15.0)
+    policy = resolve_watermark_policy(None)
+    assert (policy.lag_s, policy.late_policy) == (0.0, "side_output")
+
+    monkeypatch.setenv("DEEQU_TPU_WINDOW_SIZE_S", "0")
+    with pytest.raises(EnvConfigError, match="DEEQU_TPU_WINDOW_SIZE_S"):
+        resolve_window_spec(None)
+    monkeypatch.setenv("DEEQU_TPU_WINDOW_SIZE_S", "banana")
+    with pytest.raises(EnvConfigError, match="DEEQU_TPU_WINDOW_SIZE_S"):
+        resolve_window_spec(None)
+    monkeypatch.setenv("DEEQU_TPU_LATE_POLICY", "teleport")
+    with pytest.raises(EnvConfigError, match="DEEQU_TPU_LATE_POLICY"):
+        resolve_watermark_policy(None)
+    # explicit arguments always win over (even broken) env
+    assert resolve_watermark_policy(WatermarkPolicy(1.0)).lag_s == 1.0
+    assert LATE_POLICIES == ("drop", "side_output", "refuse")
+
+
+# -- plan-window-refeed lint drift sims ---------------------------------------
+
+
+def test_plan_window_refeed_positive_and_negative():
+    from deequ_tpu.lint.plan_lint import lint_plan
+    from deequ_tpu.ops.scan_plan import plan_windowed_scan
+
+    good = plan_windowed_scan(
+        fold_tags=("max", "min", "sum", "sum"), panes=4,
+        window_spec=(10.0, 5.0, "ts"), watermark_policy=(2.0, "drop"),
+    )
+    assert lint_plan(good) == []
+
+    def refeed_rules(plan_ir):
+        return [
+            f.rule for f in lint_plan(plan_ir)
+            if f.rule == "plan-window-refeed" and f.severity == "error"
+        ]
+
+    # drifted geometry: slide past size leaves uncovered event time
+    assert refeed_rules(
+        dataclasses.replace(good, window_spec=(10.0, 20.0, "ts"))
+    )
+    assert refeed_rules(dataclasses.replace(good, window_spec=(10.0, 5.0)))
+    # drifted policy: unknown late routing / negative lag
+    assert refeed_rules(
+        dataclasses.replace(good, watermark_policy=(2.0, "teleport"))
+    )
+    assert refeed_rules(
+        dataclasses.replace(good, watermark_policy=(-1.0, "drop"))
+    )
+    # non-elementwise fold leaf: gather cannot merge pane partials
+    assert refeed_rules(dataclasses.replace(good, fold_tags=(("sum", "gather"),)))
+    # zero panes
+    assert refeed_rules(dataclasses.replace(good, tenants=0))
+    # a NON-windowed plan must not declare window geometry
+    from deequ_tpu.ops.scan_plan import plan_fused_grouping
+
+    drifted = dataclasses.replace(
+        plan_fused_grouping((8, 4), rows=64, hist_variant="scatter"),
+        window_spec=(10.0, 5.0, "ts"),
+    )
+    assert refeed_rules(drifted)
+
+
+def test_pane_program_lints_clean_armed_error(monkeypatch):
+    from deequ_tpu.windows.engine import clear_program_cache
+
+    monkeypatch.setenv("DEEQU_TPU_PLAN_LINT", "error")
+    clear_program_cache()
+    traces_before = SCAN_STATS.plan_lint_traces
+    stream = WindowedStream(
+        "linted", ANALYZERS, spec=WindowSpec(10.0, 5.0),
+        policy=WatermarkPolicy(2.0, "drop"),
+    )
+    closes = drive(stream, _batches(n_batches=3), flush=True)
+    assert any(c.emitted for c in closes)  # armed lint did not fire
+    assert SCAN_STATS.plan_lint_traces > traces_before
+    clear_program_cache()
+
+
+# -- chaos fixtures -----------------------------------------------------------
+
+
+def test_window_chaos_fixtures_present_and_shaped():
+    """The shrunk window-seam corpus rides the tier-1 replay glob in
+    test_chaos.py; pin its presence and seam here."""
+    fixture_dir = os.path.join(os.path.dirname(__file__), "fixtures", "chaos")
+    paths = sorted(glob.glob(os.path.join(fixture_dir, "window_*.json")))
+    assert len(paths) >= 2
+    kinds = set()
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        events = [e for e in doc["events"] if e.get("seam") == "window"]
+        assert events, f"{p} carries no window-seam events"
+        kinds.update(e["kind"] for e in events)
+    assert {"kill", "overload"} <= kinds
